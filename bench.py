@@ -1,0 +1,115 @@
+"""Benchmark harness: one JSON line for the driver.
+
+Measures sustained training throughput (examples/sec/chip) of the flagship
+config on the available hardware, steady-state (post-compile), end-to-end
+through the jitted train step.
+
+``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
+ratio is against the documented era-appropriate target below for the metric
+BASELINE.json names (ResNet-50 images/sec/chip on the reference's V100
+hardware hints); >1.0 means this framework beats that bar per chip.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+# Era-appropriate per-device reference throughputs (the reference targeted
+# 4xV100 nodes, run.sbatch:2-9). Values are the well-known MLPerf-era
+# fp32 V100 numbers; see BENCH.md.
+BASELINE_PER_DEVICE = {
+    "resnet50": ("resnet50_images_per_sec_per_chip", "images/sec/chip", 380.0),
+    "mlp-wide": ("mlp_wide_examples_per_sec_per_chip", "examples/sec/chip", 1.0e6),
+}
+
+MODEL = os.environ.get("BENCH_MODEL", "resnet50")
+WARMUP_STEPS = int(os.environ.get("BENCH_WARMUP", "5"))
+TIMED_STEPS = int(os.environ.get("BENCH_STEPS", "30"))
+PER_DEVICE_BATCH = int(os.environ.get("BENCH_BATCH", "0"))  # 0 = model default
+
+
+def default_batch(model: str) -> int:
+    return {"resnet50": 128, "mlp-wide": 4096}.get(model, 128)
+
+
+def main() -> None:
+    from pytorch_ddp_template_tpu.config import TrainingConfig
+    from pytorch_ddp_template_tpu.models import available_models, build
+    from pytorch_ddp_template_tpu.runtime import make_mesh
+    from pytorch_ddp_template_tpu.runtime.context import RuntimeContext
+    from pytorch_ddp_template_tpu.train.engine import (
+        TrainState,
+        make_optimizer,
+        make_train_step,
+    )
+
+    model = MODEL if MODEL in available_models() else "mlp-wide"
+    metric, unit, baseline = BASELINE_PER_DEVICE.get(
+        model, (f"{model}_examples_per_sec_per_chip", "examples/sec/chip", 1.0)
+    )
+    per_device = PER_DEVICE_BATCH or default_batch(model)
+
+    n_dev = jax.device_count()
+    mesh = make_mesh("data:-1")
+    config = TrainingConfig(
+        model=model,
+        per_device_train_batch_size=per_device,
+        bf16=True,  # TPU-native precision: bf16 compute, f32 master params
+        dataset_size=per_device * n_dev * 2,
+        warmup_steps=0,
+        max_grad_norm=1000.0,
+    )
+    seed_key = jax.random.PRNGKey(0)
+    ctx = RuntimeContext(mesh=mesh, seed_key=seed_key,
+                         host_key=jax.random.fold_in(seed_key, 0), config=config)
+    task, dataset = build(model, config)
+
+    global_batch = per_device * n_dev
+    idx = np.arange(global_batch) % len(dataset)
+    host_batch = dataset.batch(idx)
+    batch = {
+        k: jax.device_put(v, NamedSharding(mesh, P("data")))
+        for k, v in host_batch.items()
+    }
+
+    params, extra = task.init(seed_key, batch)
+    tx, schedule = make_optimizer(config, total_steps=10_000)
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        extra_vars=extra,
+        opt_state=tx.init(params),
+        rng=jax.random.clone(seed_key),
+    )
+    state = jax.device_put(state, NamedSharding(mesh, P()))
+    train_step = make_train_step(task, tx, schedule, ctx, accum_steps=1)
+
+    for _ in range(WARMUP_STEPS):
+        state, _metrics = train_step(state, batch)
+    jax.block_until_ready(state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(TIMED_STEPS):
+        state, _metrics = train_step(state, batch)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    examples_per_sec = TIMED_STEPS * global_batch / dt
+    per_chip = examples_per_sec / n_dev
+    print(json.dumps({
+        "metric": metric,
+        "value": round(per_chip, 2),
+        "unit": unit,
+        "vs_baseline": round(per_chip / baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

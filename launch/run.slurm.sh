@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Per-node SLURM worker (reference: run.slurm.sh, which ran
+# torch.distributed.launch with --node_rank=$SLURM_NODEID). Here each node
+# runs ONE process that owns all its local chips; rendezvous goes through
+# jax.distributed.initialize via the flags below.
+set -euo pipefail
+
+exec python ddp.py \
+  --coordinator_address "${COORD_ADDR}:${COORD_PORT}" \
+  --num_processes "$SLURM_JOB_NUM_NODES" \
+  --process_id "$SLURM_NODEID" \
+  "$@"

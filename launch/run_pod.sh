@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# TPU-pod launcher (reference: run.sbatch + run.slurm.sh rendezvous dance).
+# On Cloud TPU pods, `gcloud ... ssh --worker=all` starts one process per
+# host; JAX discovers the coordinator automatically from the TPU metadata —
+# no MASTER_ADDR/port-scan equivalent is needed (that is the TPU-native
+# replacement for run.sbatch:11-12).
+set -euo pipefail
+
+TPU_NAME=${TPU_NAME:?set TPU_NAME}
+ZONE=${ZONE:?set ZONE}
+REPO_DIR=${REPO_DIR:-'~/pytorch_ddp_template_tpu'}
+
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
+  --command "cd $REPO_DIR && python ddp.py ${*@Q}"

@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Single-host launcher (reference: run.sh, which needed
+# torch.distributed.launch to spawn one process per GPU). Under JAX a single
+# process owns every local TPU chip, so "multi-device single node" is just:
+set -euo pipefail
+
+MODEL=${MODEL:-mlp}
+OUTPUT_DIR=${OUTPUT_DIR:-outputs}
+
+exec python ddp.py \
+  --model "$MODEL" \
+  --output_dir "$OUTPUT_DIR" \
+  --per_device_train_batch_size "${PER_DEVICE_BATCH:-128}" \
+  --num_train_epochs "${EPOCHS:-3}" \
+  --logging_steps "${LOGGING_STEPS:-50}" \
+  --save_steps "${SAVE_STEPS:-500}" \
+  "$@"

@@ -1,0 +1,14 @@
+"""pytorch_ddp_template_tpu — a TPU-native distributed training framework.
+
+A from-scratch JAX/XLA/pjit framework with the capability envelope of the
+PyTorch DDP template it is benchmarked against (see SURVEY.md): synchronous
+data-parallel training over a device mesh, per-host sharded input pipelines,
+in-jit gradient accumulation and global-norm clipping, warmup-linear LR
+schedules, bf16 mixed precision, step-numbered checkpoint/resume, structured
+rank-aware logging, and single-host / TPU-pod / SLURM launchers — with
+gradient allreduce expressed as XLA collectives over ICI/DCN instead of NCCL.
+"""
+
+__version__ = "0.1.0"
+
+from .config import TrainingConfig, build_arg_parser, parse_args  # noqa: F401

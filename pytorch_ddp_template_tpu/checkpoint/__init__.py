@@ -1,0 +1,5 @@
+"""Checkpoint save/restore (orbax-backed, multi-host-correct)."""
+
+from .manager import CheckpointManager
+
+__all__ = ["CheckpointManager"]

@@ -1,0 +1,92 @@
+"""Step-numbered checkpoints with symmetric save **and** restore.
+
+Capability parity-plus with the reference's checkpoint writer
+(``/root/reference/ddp.py:64-77, 254-277``): the reference saves four
+artifacts (model / args / optimizer / scheduler) into
+``outputs/checkpoint-{step}`` on rank 0, but has **no load path at all** —
+``--global-step`` is parsed and never used (SURVEY.md §2d). Here save and
+restore are symmetric, and both are multi-host-correct via orbax (every
+process participates; OCDBT handles concurrent writers — the reference's
+"no barrier after rank-0 save" hazard, SURVEY.md §3.4, cannot occur).
+
+One orbax step directory holds the whole training state: params, optimizer
+state, step, RNG key, and the JSON config (the reference's
+``training_args.bin`` equivalent, portable instead of pickled).
+The LR schedule needs no artifact — it is a pure function of the step
+(``train/schedule.py``), so restoring the step restores the schedule;
+the reference needed ``scheduler.pt`` only because ``LambdaLR`` is stateful.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Any
+
+import orbax.checkpoint as ocp
+
+from ..config import TrainingConfig
+from ..utils import get_logger
+
+log = get_logger(__name__)
+
+
+class CheckpointManager:
+    """Save/restore ``(state_pytree, config)`` at step-numbered dirs."""
+
+    def __init__(self, directory: str | Path, *, max_to_keep: int | None = None):
+        self.directory = Path(directory).absolute()
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            step_prefix="checkpoint",  # dirs named checkpoint_<step>, like the
+            #                            reference's checkpoint-<step> (ddp.py:256)
+            create=True,
+        )
+        self._mngr = ocp.CheckpointManager(self.directory, options=options)
+
+    # -- save -------------------------------------------------------------
+    def save(self, step: int, state: Any, config: TrainingConfig,
+             *, force: bool = False) -> None:
+        self._mngr.save(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(state),
+                config=ocp.args.JsonSave(dataclasses.asdict(config)),
+            ),
+            force=force,
+        )
+        log.info("checkpoint saved", {"step": step, "dir": str(self.directory)})
+
+    def wait(self) -> None:
+        """Block until any async save completes (call before process exit)."""
+        self._mngr.wait_until_finished()
+
+    # -- restore ----------------------------------------------------------
+    def latest_step(self) -> int | None:
+        return self._mngr.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return sorted(self._mngr.all_steps())
+
+    def restore(self, step: int | None, template_state: Any) -> tuple[Any, dict]:
+        """Restore ``(state, config_dict)``; ``step=None`` → latest.
+
+        ``template_state`` supplies the pytree structure/shardings so arrays
+        are restored directly onto their mesh placement.
+        """
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        restored = self._mngr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(template_state),
+                config=ocp.args.JsonRestore(),
+            ),
+        )
+        log.info("checkpoint restored", {"step": step})
+        return restored["state"], restored["config"]
+
+    def close(self) -> None:
+        self._mngr.close()

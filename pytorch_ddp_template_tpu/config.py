@@ -1,0 +1,132 @@
+"""Training configuration: the reference's 15-flag CLI surface, TPU-native.
+
+Flag-for-flag coverage of the reference argparse block
+(``/root/reference/ddp.py:291-314``), re-spelled for TPU semantics:
+
+- ``--per_gpu_train_batch_size`` → ``--per_device_train_batch_size``
+  (per TPU chip); the GPU spelling is kept as a hidden alias.
+- ``--no_cuda`` → ``--cpu`` (force the CPU backend; alias kept).
+- ``--fp16``/``--fp16_opt_level``/``--loss_scale`` → ``--bf16``. TPU MXUs
+  compute natively in bfloat16 and need no loss scaling, so the three
+  AMP knobs collapse into one; the fp16 spellings are accepted and mapped.
+- ``--local_rank`` is accepted-and-ignored (JAX owns all local chips in a
+  single process; there is no per-device process launcher).
+- ``--global-step`` is parsed *and consumed*: the reference parses it but
+  never reads it, so checkpoints can never be resumed (``ddp.py:293`` vs
+  ``ddp.py:206``, SURVEY.md §2d) — here it selects the checkpoint to
+  restore and training continues from that step.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+from pathlib import Path
+from typing import Any
+
+
+@dataclasses.dataclass
+class TrainingConfig:
+    """Everything the trainer needs, serialisable for checkpointing.
+
+    The reference pickles its whole args namespace into
+    ``training_args.bin`` (``ddp.py:260-262``); we serialise to JSON so the
+    artifact is portable and diffable.
+    """
+
+    # -- reference flag surface (ddp.py:292-309) --------------------------
+    global_step: int = 0  # resume-from step; 0 = fresh (or auto-resume latest)
+    cpu: bool = False  # reference: --no_cuda
+    output_dir: str = "outputs"
+    seed: int = 42
+    gradient_accumulation_steps: int = 1
+    per_device_train_batch_size: int = 128  # reference: --per_gpu_train_batch_size
+    max_steps: int = -1
+    logging_steps: int = 50
+    save_steps: int = 50
+    num_train_epochs: float = 3.0
+    warmup_steps: int = 0
+    max_grad_norm: float = 1000.0
+    bf16: bool = False  # reference: --fp16 (+ loss_scale/fp16_opt_level, moot on TPU)
+
+    # -- TPU-native additions ---------------------------------------------
+    learning_rate: float = 1e-3  # reference hardcodes SGD(lr=1e-3) at ddp.py:183
+    mesh: str = "data:-1"  # mesh spec, e.g. "data:-1" or "data:4,model:2"
+    coordinator_address: str | None = None  # jax.distributed rendezvous
+    num_processes: int | None = None
+    process_id: int | None = None
+    model: str = "mlp"  # model-zoo key (models/registry.py)
+    dataset_size: int = 100_000  # reference: FooDataset(100000) at ddp.py:135
+    eval_steps: int = 0  # 0 disables; reference evaluate() is a stub (ddp.py:123-124)
+    resume: bool = True  # auto-resume from latest checkpoint in output_dir
+
+    @property
+    def train_batch_size(self) -> int:
+        """Global batch per optimizer micro-step across all devices.
+
+        Reference computes ``per_gpu * max(1, n_gpu)`` (``ddp.py:110-111``);
+        on TPU the multiplier is the global device count.
+        """
+        import jax
+
+        return self.per_device_train_batch_size * jax.device_count()
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "TrainingConfig":
+        raw: dict[str, Any] = json.loads(text)
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in raw.items() if k in known})
+
+    def save(self, directory: str | Path) -> Path:
+        path = Path(directory) / "training_config.json"
+        path.write_text(self.to_json())
+        return path
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="TPU-native distributed trainer")
+    # reference surface -----------------------------------------------------
+    p.add_argument("--global-step", "--global_step", dest="global_step", type=int, default=0,
+                   help="Checkpoint step to resume from (0 = fresh or auto-latest).")
+    p.add_argument("--cpu", "--no_cuda", dest="cpu", action="store_true",
+                   help="Force the CPU backend (reference: --no_cuda).")
+    p.add_argument("--output_dir", type=str, default="outputs")
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--gradient_accumulation_steps", type=int, default=1)
+    p.add_argument("--per_device_train_batch_size", "--per_gpu_train_batch_size",
+                   dest="per_device_train_batch_size", type=int, default=128)
+    p.add_argument("--max_steps", type=int, default=-1)
+    p.add_argument("--logging_steps", type=int, default=50)
+    p.add_argument("--save_steps", type=int, default=50)
+    p.add_argument("--num_train_epochs", type=float, default=3.0)
+    p.add_argument("--warmup_steps", type=int, default=0)
+    p.add_argument("--max_grad_norm", type=float, default=1000.0)
+    p.add_argument("--local_rank", type=int, default=-1,
+                   help="Accepted for launcher compatibility; ignored under JAX.")
+    p.add_argument("--bf16", "--fp16", dest="bf16", action="store_true",
+                   help="bfloat16 compute (reference: --fp16; no loss scaling on TPU).")
+    p.add_argument("--loss_scale", type=float, default=0,
+                   help="Accepted for compatibility; bf16 needs no loss scaling.")
+    p.add_argument("--fp16_opt_level", type=str, default="O1",
+                   help="Accepted for compatibility; bf16 has a single policy.")
+    # TPU-native additions --------------------------------------------------
+    p.add_argument("--learning_rate", type=float, default=1e-3)
+    p.add_argument("--mesh", type=str, default="data:-1")
+    p.add_argument("--coordinator_address", type=str, default=None)
+    p.add_argument("--num_processes", type=int, default=None)
+    p.add_argument("--process_id", type=int, default=None)
+    p.add_argument("--model", type=str, default="mlp")
+    p.add_argument("--dataset_size", type=int, default=100_000)
+    p.add_argument("--eval_steps", type=int, default=0)
+    p.add_argument("--no_resume", dest="resume", action="store_false")
+    return p
+
+
+def parse_args(argv: list[str] | None = None) -> TrainingConfig:
+    ns = build_arg_parser().parse_args(argv)
+    known = {f.name for f in dataclasses.fields(TrainingConfig)}
+    return TrainingConfig(**{k: v for k, v in vars(ns).items() if k in known})

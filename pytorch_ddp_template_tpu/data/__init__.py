@@ -1,0 +1,22 @@
+"""Data pipeline: datasets, sharded sampling, per-host loading."""
+
+from .dataset import (
+    ArrayDataset,
+    Dataset,
+    SyntheticImageDataset,
+    SyntheticRegressionDataset,
+    SyntheticTokenDataset,
+)
+from .loader import ShardedLoader
+from .sampler import epoch_batches, shard_indices
+
+__all__ = [
+    "ArrayDataset",
+    "Dataset",
+    "SyntheticImageDataset",
+    "SyntheticRegressionDataset",
+    "SyntheticTokenDataset",
+    "ShardedLoader",
+    "shard_indices",
+    "epoch_batches",
+]

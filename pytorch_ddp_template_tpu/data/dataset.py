@@ -1,0 +1,94 @@
+"""Dataset protocol and synthetic sources.
+
+Capability parity with the reference's ``FooDataset``
+(``/root/reference/dataset.py:6-17``): a map-style dataset of
+pre-materialised random regression pairs. TPU-first difference: datasets
+here support *vectorised batch fetch* (``batch(indices)``) so the host can
+assemble a whole per-process batch in one numpy gather instead of a Python
+loop over ``__getitem__`` — host CPU feeding is the classic TPU bottleneck
+(SURVEY.md §7 hard part (e)).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Protocol, runtime_checkable
+
+import numpy as np
+
+
+@runtime_checkable
+class Dataset(Protocol):
+    """Map-style dataset: ``len()`` + vectorised ``batch(indices)``.
+
+    ``batch`` returns a pytree (typically a dict) of numpy arrays whose
+    leading dimension is ``len(indices)``.
+    """
+
+    def __len__(self) -> int: ...
+
+    def batch(self, indices: np.ndarray) -> Mapping[str, np.ndarray]: ...
+
+
+class ArrayDataset:
+    """Wrap pre-materialised arrays (leading dim = sample count)."""
+
+    def __init__(self, **arrays: np.ndarray):
+        if not arrays:
+            raise ValueError("ArrayDataset needs at least one array")
+        lengths = {k: len(v) for k, v in arrays.items()}
+        if len(set(lengths.values())) != 1:
+            raise ValueError(f"inconsistent sample counts: {lengths}")
+        self.arrays = {k: np.asarray(v) for k, v in arrays.items()}
+        self._len = next(iter(lengths.values()))
+
+    def __len__(self) -> int:
+        return self._len
+
+    def batch(self, indices: np.ndarray) -> dict[str, np.ndarray]:
+        return {k: v[indices] for k, v in self.arrays.items()}
+
+
+class SyntheticRegressionDataset(ArrayDataset):
+    """The ``FooDataset`` equivalent (``dataset.py:6-17``): ``samples``
+    standard-normal pairs ``x ∈ R^{in_dim}``, ``y ∈ R^{out_dim}``.
+
+    Unlike the reference (fresh ``torch.randn`` every construction), data is
+    deterministic in ``seed`` so loss curves are reproducible across runs
+    and hosts.
+    """
+
+    def __init__(self, samples: int = 100_000, in_dim: int = 10, out_dim: int = 5,
+                 seed: int = 0):
+        rng = np.random.default_rng(seed)
+        super().__init__(
+            x=rng.standard_normal((samples, in_dim), dtype=np.float32),
+            y=rng.standard_normal((samples, out_dim), dtype=np.float32),
+        )
+
+
+class SyntheticImageDataset(ArrayDataset):
+    """Synthetic labelled images for the vision config ladder (BASELINE.md):
+    NHWC uint8 images + int32 class labels, deterministic in ``seed``."""
+
+    def __init__(self, samples: int = 10_000, image_size: int = 224, channels: int = 3,
+                 num_classes: int = 1000, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        super().__init__(
+            image=rng.integers(0, 256, (samples, image_size, image_size, channels),
+                               dtype=np.uint8),
+            label=rng.integers(0, num_classes, (samples,), dtype=np.int32),
+        )
+        self.num_classes = num_classes
+
+
+class SyntheticTokenDataset(ArrayDataset):
+    """Synthetic token sequences for the language configs (BERT MLM ladder):
+    int32 token ids in ``[0, vocab)``, deterministic in ``seed``."""
+
+    def __init__(self, samples: int = 10_000, seq_len: int = 128, vocab: int = 30_522,
+                 seed: int = 0):
+        rng = np.random.default_rng(seed)
+        super().__init__(
+            input_ids=rng.integers(0, vocab, (samples, seq_len), dtype=np.int32),
+        )
+        self.vocab = vocab

@@ -1,0 +1,79 @@
+"""Deterministic sharded sampling with epoch reshuffle.
+
+Reproduces the semantics of ``torch.utils.data.DistributedSampler`` as used
+by the reference (``/root/reference/ddp.py:137-145`` selection,
+``ddp.py:213-214`` per-epoch reshuffle) — SURVEY.md §7 names this a hard
+part: disjoint cover of the dataset across shards, deterministic per-epoch
+reshuffle, and padding of the tail so every shard sees the same number of
+samples (a hard requirement under SPMD: every device must run every step).
+
+Design: a pure function of ``(length, num_shards, shard_id, seed, epoch)``
+— no mutable sampler object, no ``set_epoch`` side channel. The epoch is
+folded into the permutation seed, which is the JAX-idiomatic spelling of
+``sampler.set_epoch(epoch)``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def shard_indices(
+    length: int,
+    num_shards: int,
+    shard_id: int,
+    *,
+    seed: int = 0,
+    epoch: int = 0,
+    shuffle: bool = True,
+    drop_last: bool = False,
+) -> np.ndarray:
+    """Return this shard's sample indices for one epoch.
+
+    Guarantees (matching DistributedSampler):
+    - all shards together cover every index at least once (when not
+      ``drop_last``), disjointly apart from the wrap-around padding;
+    - every shard gets exactly the same count;
+    - ``epoch`` changes the permutation deterministically;
+    - different shards never overlap within the unpadded region.
+    """
+    if not 0 <= shard_id < num_shards:
+        raise ValueError(f"shard_id {shard_id} out of range for {num_shards} shards")
+    if length <= 0:
+        raise ValueError("empty dataset")
+
+    if shuffle:
+        rng = np.random.default_rng(np.random.SeedSequence([seed, epoch]))
+        indices = rng.permutation(length)
+    else:
+        indices = np.arange(length)
+
+    if drop_last:
+        total = (length // num_shards) * num_shards
+        indices = indices[:total]
+    else:
+        total = -(-length // num_shards) * num_shards  # ceil to multiple
+        if total > length:  # wrap-around padding, like DistributedSampler
+            indices = np.concatenate([indices, indices[: total - length]])
+
+    return indices[shard_id::num_shards]
+
+
+def epoch_batches(
+    shard: np.ndarray,
+    batch_size: int,
+    *,
+    drop_last: bool = True,
+) -> list[np.ndarray]:
+    """Chunk a shard's indices into per-step batches of ``batch_size``.
+
+    Under SPMD the global step count must be identical on every host, so the
+    ragged tail is dropped by default (every host computes the same number
+    of steps from the same shard length).
+    """
+    n = len(shard)
+    n_full = n // batch_size
+    batches = [shard[i * batch_size : (i + 1) * batch_size] for i in range(n_full)]
+    if not drop_last and n % batch_size:
+        batches.append(shard[n_full * batch_size :])
+    return batches

@@ -1,0 +1,15 @@
+"""Model zoo: Flax modules + Task wrappers (see registry)."""
+
+from .mlp import MLP
+from .registry import available_models, build, register
+from .task import ClassificationTask, RegressionTask, Task
+
+__all__ = [
+    "MLP",
+    "Task",
+    "RegressionTask",
+    "ClassificationTask",
+    "available_models",
+    "build",
+    "register",
+]

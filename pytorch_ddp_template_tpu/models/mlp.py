@@ -1,0 +1,30 @@
+"""The reference's toy model, TPU-native.
+
+``FooModel`` (``/root/reference/model.py:8-16``) is
+``Linear(10,10) → ReLU → Linear(10,5)``. Same architecture here as a Flax
+module with a configurable width/dtype so the identical code path scales
+from the toy config to wide MLPs that actually exercise the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    """Dense → ReLU stack. Defaults reproduce FooModel's 10→10→5."""
+
+    features: Sequence[int] = (10, 5)
+    dtype: jnp.dtype = jnp.float32  # compute dtype; bf16 under --bf16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = True) -> jnp.ndarray:
+        x = x.astype(self.dtype)
+        for i, feat in enumerate(self.features):
+            x = nn.Dense(feat, dtype=self.dtype, name=f"dense_{i}")(x)
+            if i != len(self.features) - 1:
+                x = nn.relu(x)
+        return x

@@ -1,0 +1,68 @@
+"""Model zoo registry: name → (Task, Dataset) factories.
+
+The reference's "zoo" is one hardcoded model (``ddp.py:311``); the
+BASELINE.md config ladder defines the real surface (MLP → ResNet-18/50 →
+BERT-base → ViT-B/16). Each entry builds the Flax task and its paired
+synthetic dataset from the :class:`TrainingConfig`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+from ..config import TrainingConfig
+from ..data.dataset import Dataset
+from .task import Task
+
+_REGISTRY: dict[str, Callable[[TrainingConfig], tuple[Task, Dataset]]] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def available_models() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def build(name: str, config: TrainingConfig) -> tuple[Task, Dataset]:
+    try:
+        factory = _REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown model {name!r}; available: {available_models()}"
+        ) from None
+    return factory(config)
+
+
+def _dtype(config: TrainingConfig):
+    return jnp.bfloat16 if config.bf16 else jnp.float32
+
+
+@register("mlp")
+def _mlp(config: TrainingConfig):
+    from ..data.dataset import SyntheticRegressionDataset
+    from .mlp import MLP
+    from .task import RegressionTask
+
+    task = RegressionTask(MLP(features=(10, 5), dtype=_dtype(config)))
+    ds = SyntheticRegressionDataset(samples=config.dataset_size, seed=config.seed)
+    return task, ds
+
+
+@register("mlp-wide")
+def _mlp_wide(config: TrainingConfig):
+    """MXU-sized MLP: same path as the toy config but with 1024-wide
+    matmuls so single-chip benchmarking measures compute, not dispatch."""
+    from ..data.dataset import SyntheticRegressionDataset
+    from .mlp import MLP
+    from .task import RegressionTask
+
+    task = RegressionTask(MLP(features=(1024, 1024, 5), dtype=_dtype(config)))
+    ds = SyntheticRegressionDataset(samples=config.dataset_size, seed=config.seed)
+    return task, ds

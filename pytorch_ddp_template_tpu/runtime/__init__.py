@@ -1,0 +1,27 @@
+"""Distributed runtime: context init/teardown, mesh construction."""
+
+from .context import (
+    DATA_AXIS,
+    EXPERT_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+    SEQ_AXIS,
+    RuntimeContext,
+    init,
+    make_mesh,
+    parse_mesh_spec,
+    shutdown,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "SEQ_AXIS",
+    "PIPE_AXIS",
+    "EXPERT_AXIS",
+    "RuntimeContext",
+    "init",
+    "make_mesh",
+    "parse_mesh_spec",
+    "shutdown",
+]

@@ -1,0 +1,166 @@
+"""Distributed runtime context: init, mesh, teardown.
+
+Capability parity with the reference's ``setup``/``cleanup``
+(``/root/reference/ddp.py:80-121``), TPU-first:
+
+- The reference spawns one process per GPU and rendezvouses over a TCP
+  store (``MASTER_ADDR``/``MASTER_PORT``, ``ddp.py:103``). Under JAX one
+  process per *host* drives all local chips; multi-host rendezvous is
+  ``jax.distributed.initialize(coordinator_address, num_processes,
+  process_id)``, discovered automatically on TPU pods.
+- The reference binds a device per process (``ddp.py:100-101``). Here
+  device placement is declarative: a :class:`jax.sharding.Mesh` over all
+  global devices, with named axes. DDP's implicit gradient allreduce
+  (``ddp.py:194-195, 231``) becomes sharding-induced ``psum`` over the
+  ``data`` axis — XLA emits the collectives over ICI/DCN.
+- ``set_seed`` (``ddp.py:44-49``) seeds three global RNGs identically on
+  every rank; JAX threads explicit ``PRNGKey`` state instead. We fold in
+  the process index for host-local streams (data order) while keeping a
+  shared key for init (parameter broadcast equivalence).
+"""
+
+from __future__ import annotations
+
+import atexit
+import dataclasses
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import TrainingConfig
+from ..utils import get_logger, redirect_warnings_to_logger
+
+log = get_logger(__name__)
+
+#: Canonical mesh axis names. ``data`` carries the DDP capability; the rest
+#: keep the mesh extensible to tensor/sequence/pipeline/expert parallelism
+#: (SURVEY.md §2b: leave a model axis open).
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
+EXPERT_AXIS = "expert"
+
+
+def parse_mesh_spec(spec: str, n_devices: int) -> dict[str, int]:
+    """Parse ``"data:4,model:2"`` into an ordered ``{axis: size}`` dict.
+
+    A single ``-1`` size is inferred from the device count (like a reshape
+    wildcard). Validates the product against ``n_devices``.
+    """
+    axes: dict[str, int] = {}
+    wildcard: str | None = None
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, size_s = part.partition(":")
+        size = int(size_s) if size_s else -1
+        if size == -1:
+            if wildcard is not None:
+                raise ValueError(f"mesh spec {spec!r}: more than one -1 axis")
+            wildcard = name
+        axes[name] = size
+    if wildcard is not None:
+        known = int(np.prod([s for s in axes.values() if s != -1])) if len(axes) > 1 else 1
+        if n_devices % known:
+            raise ValueError(f"mesh spec {spec!r} does not divide {n_devices} devices")
+        axes[wildcard] = n_devices // known
+    total = int(np.prod(list(axes.values())))
+    if total != n_devices:
+        raise ValueError(
+            f"mesh spec {spec!r} covers {total} devices but {n_devices} are present"
+        )
+    return axes
+
+
+def make_mesh(spec: str = "data:-1", devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """Build a named Mesh over the global device array.
+
+    Devices are laid out in their default (ICI-contiguous) order so that the
+    innermost mesh axis maps to physically adjacent chips — collectives on
+    that axis ride ICI, not DCN. For multi-slice topologies put ``data``
+    outermost (DCN-friendly allreduce) and model/seq axes innermost.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    axes = parse_mesh_spec(spec, len(devices))
+    shape = tuple(axes.values())
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, tuple(axes.keys()))
+
+
+@dataclasses.dataclass
+class RuntimeContext:
+    """What ``setup()`` hands the trainer (reference mutates ``args``;
+    we return an explicit context object)."""
+
+    mesh: Mesh
+    seed_key: jax.Array  # shared across hosts — param init / dropout
+    host_key: jax.Array  # folded with process_index — data order etc.
+    config: TrainingConfig
+
+    @property
+    def n_devices(self) -> int:
+        return self.mesh.devices.size
+
+    def data_sharding(self, *trailing_axes: str | None) -> NamedSharding:
+        """Sharding for a batch array: leading dim split over ``data``."""
+        return NamedSharding(self.mesh, P(DATA_AXIS, *trailing_axes))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+
+_initialized = False
+
+
+def init(config: TrainingConfig) -> RuntimeContext:
+    """Establish the distributed context. Reference: ``setup`` ddp.py:80-115.
+
+    Single-process (no coordinator configured, one host) skips
+    ``jax.distributed.initialize`` entirely — the same code path then runs
+    from a laptop CPU to a v4-32 pod (SURVEY.md §4: the reference's CPU path
+    is its de-facto fake backend; ours is literally the same path).
+    """
+    global _initialized
+    redirect_warnings_to_logger(log)
+    if config.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    if config.coordinator_address is not None and not _initialized:
+        jax.distributed.initialize(
+            coordinator_address=config.coordinator_address,
+            num_processes=config.num_processes,
+            process_id=config.process_id,
+        )
+        _initialized = True
+        atexit.register(shutdown)
+
+    devices = jax.devices()
+    mesh = make_mesh(config.mesh, devices)
+    seed_key = jax.random.PRNGKey(config.seed)
+    host_key = jax.random.fold_in(seed_key, jax.process_index())
+    log.info(
+        "runtime initialised",
+        {
+            "process": f"{jax.process_index()}/{jax.process_count()}",
+            "local_devices": jax.local_device_count(),
+            "global_devices": len(devices),
+            "platform": devices[0].platform,
+            "mesh": dict(zip(mesh.axis_names, mesh.devices.shape)),
+            "seed": config.seed,
+        },
+    )
+    return RuntimeContext(mesh=mesh, seed_key=seed_key, host_key=host_key, config=config)
+
+
+def shutdown() -> None:
+    """Teardown (reference: ``cleanup`` ddp.py:118-121). Safe to call twice."""
+    global _initialized
+    if _initialized:
+        try:
+            jax.distributed.shutdown()
+        except Exception:  # noqa: BLE001 - shutdown must never raise at exit
+            pass
+        _initialized = False

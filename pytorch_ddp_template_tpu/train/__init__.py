@@ -1,0 +1,21 @@
+"""Training engine: jitted SPMD step, schedules, metrics, orchestration."""
+
+from .engine import (
+    Trainer,
+    TrainState,
+    make_eval_step,
+    make_optimizer,
+    make_train_step,
+)
+from .metrics import MetricsWriter
+from .schedule import linear_schedule_with_warmup
+
+__all__ = [
+    "Trainer",
+    "TrainState",
+    "make_train_step",
+    "make_eval_step",
+    "make_optimizer",
+    "MetricsWriter",
+    "linear_schedule_with_warmup",
+]

@@ -1,0 +1,61 @@
+"""Metrics emission: TensorBoard + JSONL, main-process only.
+
+Capability parity with the reference's TB block
+(``/root/reference/ddp.py:36-39, 128-129, 246-252``): ``lr`` and windowed
+mean ``loss`` scalars every ``logging_steps``, written by the main process
+only. Two fixes over the reference:
+
+- the reference's loss window divides by ``logging_steps`` while
+  accumulating per *micro*-batch, mis-scaling the reported loss whenever
+  ``gradient_accumulation_steps > 1`` (SURVEY.md §2d); here the window is a
+  true mean over optimizer steps (accumulation is inside the jitted step).
+- scalars also go to a ``metrics.jsonl`` file, so runs are machine-readable
+  without TB and the bench harness can consume them directly.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Any
+
+from ..utils import get_logger, is_main_process
+
+log = get_logger(__name__)
+
+
+class MetricsWriter:
+    """Host-0 scalar writer: TensorBoard events (if available) + JSONL."""
+
+    def __init__(self, directory: str | Path):
+        self.active = is_main_process()
+        self._tb = None
+        if not self.active:
+            return
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self._jsonl = (self.directory / "metrics.jsonl").open("a", buffering=1)
+        try:  # tensorboard is optional; JSONL is the always-on channel
+            from torch.utils.tensorboard import SummaryWriter
+
+            self._tb = SummaryWriter(log_dir=str(self.directory))
+        except Exception:  # noqa: BLE001
+            log.info("tensorboard unavailable; writing JSONL metrics only")
+
+    def write(self, step: int, scalars: dict[str, Any]) -> None:
+        if not self.active:
+            return
+        record = {"step": step, "time": time.time()}
+        record.update({k: float(v) for k, v in scalars.items()})
+        self._jsonl.write(json.dumps(record) + "\n")
+        if self._tb is not None:
+            for k, v in scalars.items():
+                self._tb.add_scalar(k, float(v), global_step=step)
+
+    def close(self) -> None:
+        if not self.active:
+            return
+        self._jsonl.close()
+        if self._tb is not None:
+            self._tb.close()

@@ -1,0 +1,36 @@
+"""Learning-rate schedules.
+
+Capability parity with ``get_linear_schedule_with_warmup``
+(``/root/reference/ddp.py:52-61``): linear warmup from 0 over
+``warmup_steps``, then linear decay to 0 at ``total_steps``. The reference
+implements this as a ``LambdaLR`` multiplier; here it is a pure function of
+the optimizer step — directly consumable by optax and traceable under jit.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+
+def linear_schedule_with_warmup(
+    base_lr: float, warmup_steps: int, total_steps: int
+) -> optax.Schedule:
+    """``lr(step)``: ``base_lr * step/warmup`` then linear decay to 0.
+
+    Matches the reference multiplier exactly (``ddp.py:56-60``), including
+    the ``max(0, ...)`` floor past ``total_steps``.
+    """
+
+    def schedule(step: jnp.ndarray) -> jnp.ndarray:
+        step = jnp.asarray(step, jnp.float32)
+        warm = jnp.asarray(max(1.0, warmup_steps), jnp.float32)  # div guard only
+        total = jnp.asarray(max(1.0, total_steps), jnp.float32)
+        warmup_frac = step / warm
+        decay_denom = jnp.maximum(1.0, total - float(warmup_steps))
+        decay_frac = jnp.maximum(0.0, (total - step) / decay_denom)
+        # note: condition uses the true warmup_steps, so warmup_steps == 0
+        # never routes step 0 through the (zero-lr) warmup branch
+        return base_lr * jnp.where(step < float(warmup_steps), warmup_frac, decay_frac)
+
+    return schedule

@@ -1,0 +1,20 @@
+"""Observability and process helpers (reference: ``utils.py``)."""
+
+from .dist import (
+    global_device_count,
+    is_main_process,
+    local_device_count,
+    process_count,
+    process_index,
+)
+from .logging import get_logger, redirect_warnings_to_logger
+
+__all__ = [
+    "get_logger",
+    "redirect_warnings_to_logger",
+    "process_index",
+    "process_count",
+    "is_main_process",
+    "local_device_count",
+    "global_device_count",
+]

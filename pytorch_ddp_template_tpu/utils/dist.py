@@ -1,0 +1,63 @@
+"""Process/rank helpers with uninitialised-safe fallbacks.
+
+Capability parity with the reference's ``get_rank``/``get_world_size``/
+``is_main_process`` (``/root/reference/utils.py:84-101``), which fall back to
+rank 0 / world size 1 when ``torch.distributed`` is unavailable or
+uninitialised. Here the runtime is JAX: a single process drives all local
+chips, so "rank" means the JAX *process* (host), not a device.
+
+These helpers never import-fail and never raise when JAX's distributed
+runtime is not initialised — single-process development and unit tests use
+the same code path as a multi-host pod (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+
+def process_index() -> int:
+    """Global index of this host process (0 when not distributed)."""
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # noqa: BLE001 - pre-init / no-backend fallback
+        return 0
+
+
+def process_count() -> int:
+    """Number of host processes participating (1 when not distributed)."""
+    try:
+        import jax
+
+        return jax.process_count()
+    except Exception:  # noqa: BLE001
+        return 1
+
+
+def is_main_process() -> bool:
+    """True on the coordinating host — the checkpoint/metrics writer.
+
+    Mirrors ``is_main_process()`` (``utils.py:99-101``): rank 0, with a safe
+    ``True`` when running undistributed.
+    """
+    return process_index() == 0
+
+
+def local_device_count() -> int:
+    """Number of accelerator devices attached to this host (1 fallback)."""
+    try:
+        import jax
+
+        return jax.local_device_count()
+    except Exception:  # noqa: BLE001
+        return 1
+
+
+def global_device_count() -> int:
+    """Total devices across all hosts (1 fallback)."""
+    try:
+        import jax
+
+        return jax.device_count()
+    except Exception:  # noqa: BLE001
+        return 1

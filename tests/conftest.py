@@ -1,0 +1,28 @@
+"""Test harness: 8 virtual CPU devices, the JAX answer to "test collectives
+without a cluster" (SURVEY.md §4). Must run before the first jax import."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# Some plugin platforms (e.g. the axon TPU tunnel) ignore the JAX_PLATFORMS
+# env var — force CPU through the config API as well.
+jax.config.update("jax_platforms", "cpu")
+try:  # jax >= 0.5 spelling; XLA_FLAGS above covers driver environments
+    jax.config.update("jax_num_cpu_devices", 8)
+except Exception:  # noqa: BLE001 - older jax: XLA_FLAGS alone applies
+    pass
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()

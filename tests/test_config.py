@@ -1,0 +1,51 @@
+"""Tests for the CLI/config surface (reference flags: ddp.py:292-309)."""
+
+import pytest
+
+from pytorch_ddp_template_tpu.config import TrainingConfig, parse_args
+
+
+def test_defaults_match_reference():
+    cfg = parse_args([])
+    assert cfg.max_grad_norm == 1000.0  # ddp.py:305 default
+    assert cfg.gradient_accumulation_steps == 1
+    assert cfg.num_train_epochs == 3.0
+    assert cfg.max_steps == -1
+    assert cfg.seed == 42
+    assert cfg.output_dir == "outputs"
+
+
+def test_reference_spelling_aliases():
+    cfg = parse_args([
+        "--per_gpu_train_batch_size", "32",
+        "--no_cuda",
+        "--fp16",
+        "--global-step", "500",
+        "--local_rank", "2",  # accepted, ignored
+    ])
+    assert cfg.per_device_train_batch_size == 32
+    assert cfg.cpu is True
+    assert cfg.bf16 is True
+    assert cfg.global_step == 500
+
+
+def test_json_roundtrip(tmp_path):
+    cfg = parse_args(["--seed", "7", "--warmup_steps", "100"])
+    path = cfg.save(tmp_path)
+    restored = TrainingConfig.from_json(path.read_text())
+    assert restored == cfg
+
+
+def test_from_json_ignores_unknown_keys():
+    cfg = TrainingConfig.from_json('{"seed": 9, "not_a_field": true}')
+    assert cfg.seed == 9
+
+
+def test_train_batch_size_scales_with_devices(devices):
+    cfg = TrainingConfig(per_device_train_batch_size=4)
+    assert cfg.train_batch_size == 4 * len(devices)  # 8 virtual devices
+
+
+def test_unknown_flag_rejected():
+    with pytest.raises(SystemExit):
+        parse_args(["--definitely_not_a_flag"])

@@ -1,0 +1,157 @@
+"""Data-layer tests: DistributedSampler-equivalent semantics (SURVEY.md §7
+hard part (a)) and global batch assembly on the 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+from pytorch_ddp_template_tpu.data import (
+    ArrayDataset,
+    ShardedLoader,
+    SyntheticRegressionDataset,
+    epoch_batches,
+    shard_indices,
+)
+from pytorch_ddp_template_tpu.runtime import make_mesh
+
+
+class TestShardIndices:
+    def test_disjoint_cover_with_padding(self):
+        length, shards = 103, 4  # ragged: pad to 104
+        all_idx = [shard_indices(length, shards, s, seed=1, epoch=0) for s in range(shards)]
+        sizes = {len(a) for a in all_idx}
+        assert sizes == {26}  # equal count per shard
+        union = np.concatenate(all_idx)
+        assert set(union.tolist()) == set(range(length))  # full cover
+        assert len(union) == 104  # exactly one duplicated sample (padding)
+
+    def test_disjoint_without_padding(self):
+        all_idx = [shard_indices(100, 4, s, seed=0, epoch=0) for s in range(4)]
+        union = np.concatenate(all_idx)
+        assert sorted(union.tolist()) == list(range(100))  # exact partition
+
+    def test_epoch_reshuffles_deterministically(self):
+        a0 = shard_indices(1000, 4, 2, seed=5, epoch=0)
+        a0_again = shard_indices(1000, 4, 2, seed=5, epoch=0)
+        a1 = shard_indices(1000, 4, 2, seed=5, epoch=1)
+        np.testing.assert_array_equal(a0, a0_again)
+        assert not np.array_equal(a0, a1)
+
+    def test_no_shuffle_is_strided(self):
+        idx = shard_indices(12, 3, 1, shuffle=False)
+        np.testing.assert_array_equal(idx, [1, 4, 7, 10])
+
+    def test_drop_last(self):
+        all_idx = [shard_indices(10, 4, s, shuffle=False, drop_last=True) for s in range(4)]
+        assert all(len(a) == 2 for a in all_idx)
+        assert sorted(np.concatenate(all_idx).tolist()) == list(range(8))
+
+    def test_errors(self):
+        with pytest.raises(ValueError):
+            shard_indices(10, 4, 4)
+        with pytest.raises(ValueError):
+            shard_indices(0, 1, 0)
+
+
+class TestEpochBatches:
+    def test_chunking(self):
+        batches = epoch_batches(np.arange(10), 3)
+        assert [len(b) for b in batches] == [3, 3, 3]  # tail dropped
+
+    def test_keep_tail(self):
+        batches = epoch_batches(np.arange(10), 3, drop_last=False)
+        assert [len(b) for b in batches] == [3, 3, 3, 1]
+
+
+class TestDatasets:
+    def test_synthetic_deterministic(self):
+        a = SyntheticRegressionDataset(100, seed=3)
+        b = SyntheticRegressionDataset(100, seed=3)
+        np.testing.assert_array_equal(a.arrays["x"], b.arrays["x"])
+        assert a.arrays["x"].shape == (100, 10)
+        assert a.arrays["y"].shape == (100, 5)
+
+    def test_batch_gather(self):
+        ds = ArrayDataset(x=np.arange(20).reshape(10, 2))
+        out = ds.batch(np.array([3, 1]))
+        np.testing.assert_array_equal(out["x"], [[6, 7], [2, 3]])
+
+    def test_mismatched_lengths_rejected(self):
+        with pytest.raises(ValueError):
+            ArrayDataset(x=np.zeros(3), y=np.zeros(4))
+
+
+class TestShardedLoader:
+    def test_global_batch_sharded_over_mesh(self, devices):
+        mesh = make_mesh("data:-1")
+        ds = SyntheticRegressionDataset(256, seed=0)
+        loader = ShardedLoader(ds, mesh, global_batch_size=32, seed=0, prefetch=0)
+        batches = list(loader.epoch(0))
+        assert len(batches) == loader.steps_per_epoch == 256 // 32
+        b = batches[0]
+        assert b["x"].shape == (32, 10)
+        assert b["y"].shape == (32, 5)
+        # sharded over 8 devices: 4 rows per device
+        assert b["x"].addressable_shards[0].data.shape == (4, 10)
+
+    def test_prefetch_equals_sync(self, devices):
+        mesh = make_mesh("data:-1")
+        ds = SyntheticRegressionDataset(128, seed=0)
+        sync = list(ShardedLoader(ds, mesh, 32, seed=9, prefetch=0).epoch(2))
+        pre = list(ShardedLoader(ds, mesh, 32, seed=9, prefetch=2).epoch(2))
+        assert len(sync) == len(pre)
+        for s, p in zip(sync, pre):
+            np.testing.assert_array_equal(np.asarray(s["x"]), np.asarray(p["x"]))
+
+    def test_epoch_order_changes(self, devices):
+        mesh = make_mesh("data:-1")
+        ds = SyntheticRegressionDataset(128, seed=0)
+        loader = ShardedLoader(ds, mesh, 32, seed=0, prefetch=0)
+        e0 = np.asarray(next(iter(loader.epoch(0)))["x"])
+        e1 = np.asarray(next(iter(loader.epoch(1)))["x"])
+        assert not np.array_equal(e0, e1)
+
+    def test_works_with_model_axis_in_mesh(self, devices):
+        mesh = make_mesh("data:4,model:2")
+        ds = SyntheticRegressionDataset(64, seed=0)
+        loader = ShardedLoader(ds, mesh, 16, prefetch=0)
+        b = next(iter(loader.epoch(0)))
+        # batch dim split over data(4) only; replicated over model(2)
+        assert b["x"].shape == (16, 10)
+        assert b["x"].addressable_shards[0].data.shape == (4, 10)
+
+    def test_indivisible_batch_rejected(self, devices):
+        mesh = make_mesh("data:-1")
+        ds = SyntheticRegressionDataset(64)
+        with pytest.raises(ValueError):
+            ShardedLoader(ds, mesh, 12)  # 12 % 8 != 0
+
+
+class TestLoaderRobustness:
+    def test_abandoned_generator_stops_producer(self, devices):
+        import threading
+        from pytorch_ddp_template_tpu.runtime import make_mesh
+
+        mesh = make_mesh("data:-1")
+        ds = SyntheticRegressionDataset(512, seed=0)
+        loader = ShardedLoader(ds, mesh, 32, prefetch=2)
+        gen = loader.epoch(0)
+        next(gen)  # consume one, abandon the rest
+        gen.close()
+        import time
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if not any(t.name == "loader-prefetch" and t.is_alive()
+                       for t in threading.enumerate()):
+                break
+            time.sleep(0.05)
+        assert not any(t.name == "loader-prefetch" and t.is_alive()
+                       for t in threading.enumerate())
+
+    def test_accum_micro_dim_divisibility_checked(self, devices):
+        from pytorch_ddp_template_tpu.runtime import make_mesh
+
+        mesh = make_mesh("data:-1")  # data axis = 8
+        ds = SyntheticRegressionDataset(512, seed=0)
+        with pytest.raises(ValueError, match="micro batch"):
+            # global 24 % data 8 == 0, but micro dim 24/2=12 and 12 % 8 != 0
+            ShardedLoader(ds, mesh, 24, accum_steps=2)

