@@ -91,15 +91,20 @@ def main() -> None:
     state = jax.device_put(state, NamedSharding(mesh, P()))
     train_step = make_train_step(task, tx, schedule, ctx, accum_steps=1)
 
+    # Sync by fetching a real value: on some PJRT transports (e.g. the axon
+    # tunnel) block_until_ready can return before compute has finished,
+    # which would inflate throughput ~100x. A host read of a scalar that
+    # depends on every step cannot lie.
     for _ in range(WARMUP_STEPS):
-        state, _metrics = train_step(state, batch)
-    jax.block_until_ready(state.params)
+        state, metrics = train_step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
 
     t0 = time.perf_counter()
     for _ in range(TIMED_STEPS):
-        state, _metrics = train_step(state, batch)
-    jax.block_until_ready(state.params)
+        state, metrics = train_step(state, batch)
+    final_loss = float(metrics["loss"])
     dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss), f"non-finite loss {final_loss}"
 
     examples_per_sec = TIMED_STEPS * global_batch / dt
     per_chip = examples_per_sec / n_dev

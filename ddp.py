@@ -33,7 +33,7 @@ def make_eval_dataset(config, train_ds):
     if isinstance(train_ds, SyntheticImageDataset):
         return SyntheticImageDataset(
             samples=n,
-            image_size=train_ds.arrays["image"].shape[1],
+            image_size=train_ds.image_size,
             num_classes=train_ds.num_classes,
             seed=eval_seed,
         )

@@ -66,19 +66,42 @@ class SyntheticRegressionDataset(ArrayDataset):
         )
 
 
-class SyntheticImageDataset(ArrayDataset):
+class SyntheticImageDataset:
     """Synthetic labelled images for the vision config ladder (BASELINE.md):
-    NHWC uint8 images + int32 class labels, deterministic in ``seed``."""
+    NHWC uint8 images + int32 class labels, deterministic in ``seed``.
+
+    *Lazy*: images are generated per-batch from counter-based (Philox) RNG
+    streams keyed on ``(seed, sample_index)`` — an ImageNet-shaped dataset at
+    the default 100k samples would otherwise pre-materialise ~15 GB of host
+    RAM. Generation runs inside the loader's prefetch thread, overlapped
+    with device compute.
+    """
 
     def __init__(self, samples: int = 10_000, image_size: int = 224, channels: int = 3,
                  num_classes: int = 1000, seed: int = 0):
-        rng = np.random.default_rng(seed)
-        super().__init__(
-            image=rng.integers(0, 256, (samples, image_size, image_size, channels),
-                               dtype=np.uint8),
-            label=rng.integers(0, num_classes, (samples,), dtype=np.int32),
-        )
-        self.num_classes = num_classes
+        self._samples = int(samples)
+        self.image_size = int(image_size)
+        self.channels = int(channels)
+        self.num_classes = int(num_classes)
+        self.seed = int(seed)
+        # labels are tiny — materialise once for O(1) batch gather
+        rng = np.random.default_rng(np.random.Philox(key=[self.seed, 0]))
+        self._labels = rng.integers(0, num_classes, (self._samples,), dtype=np.int32)
+
+    def __len__(self) -> int:
+        return self._samples
+
+    def batch(self, indices: np.ndarray) -> dict[str, np.ndarray]:
+        indices = np.asarray(indices)
+        shape = (self.image_size, self.image_size, self.channels)
+        images = np.empty((len(indices), *shape), dtype=np.uint8)
+        for row, i in enumerate(indices):
+            # seed and index in separate Philox key words: additive mixing
+            # would alias sample i of seed s with sample i-k of seed s+k,
+            # making a different-seed eval split overlap the train set
+            gen = np.random.Generator(np.random.Philox(key=[self.seed, 1 + int(i)]))
+            images[row] = gen.integers(0, 256, shape, dtype=np.uint8)
+        return {"image": images, "label": self._labels[indices]}
 
 
 class SyntheticTokenDataset(ArrayDataset):

@@ -121,13 +121,16 @@ class ShardedLoader:
             out[k] = jax.make_array_from_process_local_data(self._sharding, v)
         return out
 
-    def epoch(self, epoch: int) -> Iterator[dict[str, jax.Array]]:
+    def epoch(self, epoch: int, start_batch: int = 0) -> Iterator[dict[str, jax.Array]]:
         """Yield one epoch of globally-sharded batches.
 
         With ``prefetch > 0``, a daemon thread gathers + device-puts batches
         ahead of consumption so host I/O overlaps device compute.
+        ``start_batch`` (mid-epoch resume) drops the first N index batches
+        *before* any data is generated or transferred — skipping by
+        iterating would pay full host gather + H2D cost per skipped batch.
         """
-        batches = self._host_batches(epoch)
+        batches = self._host_batches(epoch)[start_batch:]
         if self.prefetch <= 0:
             for idx in batches:
                 yield self._assemble(self.dataset.batch(idx))

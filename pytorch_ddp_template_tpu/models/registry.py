@@ -66,3 +66,36 @@ def _mlp_wide(config: TrainingConfig):
     task = RegressionTask(MLP(features=(1024, 1024, 5), dtype=_dtype(config)))
     ds = SyntheticRegressionDataset(samples=config.dataset_size, seed=config.seed)
     return task, ds
+
+
+def _image_entry(config: TrainingConfig, model_cls, image_size: int,
+                 num_classes: int, stem: str):
+    from ..data.dataset import SyntheticImageDataset
+    from .task import ClassificationTask
+
+    task = ClassificationTask(
+        model_cls(num_classes=num_classes, dtype=_dtype(config), stem=stem)
+    )
+    ds = SyntheticImageDataset(
+        samples=config.dataset_size, image_size=image_size,
+        num_classes=num_classes, seed=config.seed,
+    )
+    return task, ds
+
+
+@register("resnet18")
+def _resnet18(config: TrainingConfig):
+    """ResNet-18 / CIFAR-10-shaped data (BASELINE.md ladder rung 2)."""
+    from .resnet import ResNet18
+
+    return _image_entry(config, ResNet18, image_size=32, num_classes=10,
+                        stem="cifar")
+
+
+@register("resnet50")
+def _resnet50(config: TrainingConfig):
+    """ResNet-50 / ImageNet-shaped data — the BASELINE.json headline config."""
+    from .resnet import ResNet50
+
+    return _image_entry(config, ResNet50, image_size=224, num_classes=1000,
+                        stem="imagenet")

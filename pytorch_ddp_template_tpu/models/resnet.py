@@ -1,0 +1,138 @@
+"""ResNet family (18/50) — the vision rungs of the BASELINE.md config ladder.
+
+The reference's zoo is a single hardcoded MLP (``/root/reference/model.py:8-16``,
+constructed at ``ddp.py:311``); BASELINE.json names ResNet-50 images/sec/chip
+as the headline metric, so this file provides the standard ResNet-v1.5
+family as Flax modules, TPU-first:
+
+- NHWC layout throughout (the TPU-native convolution layout; XLA tiles
+  NHWC convs directly onto the MXU).
+- Compute dtype is configurable (bf16 under ``--bf16``); BatchNorm statistics
+  and the final logits stay f32 for numerical stability.
+- BatchNorm batch statistics live in the ``batch_stats`` collection, threaded
+  through the engine as ``extra_vars``. Under ``jit`` with the batch sharded
+  over the ``data`` mesh axis, the batch-mean/variance reductions are *global*
+  (GSPMD inserts the cross-replica collective) — i.e. sync-BN for free, where
+  the reference's DDP keeps per-GPU local statistics.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    """Two 3x3 convs; the ResNet-18/34 residual block."""
+
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+    strides: tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1), self.strides,
+                                 name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 → 3x3 → 1x1 bottleneck; the ResNet-50/101/152 block (v1.5:
+    stride on the 3x3, not the first 1x1)."""
+
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+    strides: tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), self.strides,
+                                 name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    """ResNet v1.5, NHWC, with an ImageNet (7x7/s2 + maxpool) or CIFAR
+    (3x3/s1, no pool) stem."""
+
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int
+    num_filters: int = 64
+    dtype: jnp.dtype = jnp.float32
+    stem: str = "imagenet"  # or "cifar"
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       padding="SAME")
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=jnp.float32,  # stats in f32 even under bf16 compute
+        )
+        act = nn.relu
+
+        x = x.astype(self.dtype)
+        if self.stem == "imagenet":
+            x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+            x = norm(name="bn_init")(x)
+            x = act(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        elif self.stem == "cifar":
+            x = conv(self.num_filters, (3, 3), (1, 1), name="conv_init")(x)
+            x = norm(name="bn_init")(x)
+            x = act(x)
+        else:
+            raise ValueError(f"unknown stem {self.stem!r}")
+
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(
+                    filters=self.num_filters * 2**i,
+                    conv=conv,
+                    norm=norm,
+                    act=act,
+                    strides=strides,
+                )(x)
+
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x
+
+
+ResNet18 = partial(ResNet, stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock)
+ResNet34 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=BasicBlock)
+ResNet50 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=BottleneckBlock)
+ResNet101 = partial(ResNet, stage_sizes=(3, 4, 23, 3), block_cls=BottleneckBlock)

@@ -296,11 +296,11 @@ class Trainer:
         done = False
 
         for epoch in range(start_epoch, self.num_epochs):
-            for i, batch in enumerate(self.loader.epoch(epoch)):
-                # on resume mid-epoch, skip already-consumed batches so the
-                # data order matches an uninterrupted run
-                if epoch == start_epoch and i < start_step % self.steps_per_epoch:
-                    continue
+            # on resume mid-epoch, drop already-consumed batches in the
+            # loader (before generation/transfer) so the data order matches
+            # an uninterrupted run
+            skip = start_step % self.steps_per_epoch if epoch == start_epoch else 0
+            for batch in self.loader.epoch(epoch, start_batch=skip):
                 state, metrics = self.train_step(state, batch)
                 global_step += 1
                 if cfg.logging_steps:  # window only consumed when logging
