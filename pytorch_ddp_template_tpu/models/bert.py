@@ -1,0 +1,163 @@
+"""BERT-family masked-LM — the language rung of the BASELINE.md ladder.
+
+No counterpart exists in the reference (zoo = one MLP,
+``/root/reference/model.py:8-16``); BASELINE.md names "BERT-base MLM
+fine-tune" as ladder rung 4. TPU-first choices:
+
+- Post-LN encoder from ``models/transformer.py`` (flash attention on TPU).
+- Embedding table carries logical axes ``("vocab", "embed")`` so tensor
+  parallelism can shard the vocab dimension (``parallel/sharding.py``).
+- MLM head ties the decoder to the word embedding (standard BERT) — one
+  (vocab, embed) matrix, one transpose matmul on the MXU.
+- Dynamic masking happens *inside jit* on device (``MlmTask.loss``): the
+  host ships raw int32 token ids (4 bytes/token over PCIe) and the 15%
+  BERT corruption (80/10/10 mask/random/keep) is drawn from the step rng —
+  fresh masks every epoch with zero host cost, where a torch pipeline
+  would re-run a Python collator every batch.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import Impl
+from .task import Task
+from .transformer import TransformerEncoder, default_kernel_init
+
+
+class BertEncoder(nn.Module):
+    """BERT encoder: embeddings + post-LN transformer stack, returning
+    final hidden states; the MLM logits come from the tied embedding."""
+
+    vocab_size: int = 30_522
+    max_len: int = 512
+    num_layers: int = 12
+    num_heads: int = 12
+    head_dim: int = 64
+    mlp_dim: int = 3072
+    dtype: jnp.dtype = jnp.float32
+    dropout_rate: float = 0.1
+    attn_impl: Impl = "auto"
+    remat: bool = False
+
+    def setup(self):
+        embed_dim = self.num_heads * self.head_dim
+        self.word_embed = nn.Embed(
+            self.vocab_size,
+            embed_dim,
+            dtype=self.dtype,
+            embedding_init=nn.with_logical_partitioning(
+                default_kernel_init, ("vocab", "embed")
+            ),
+            name="word_embeddings",
+        )
+        self.pos_embed = nn.Embed(
+            self.max_len, embed_dim, dtype=self.dtype,
+            embedding_init=default_kernel_init, name="position_embeddings",
+        )
+        self.embed_ln = nn.LayerNorm(dtype=jnp.float32, name="embeddings_ln")
+        self.dropout = nn.Dropout(self.dropout_rate)
+        self.encoder = TransformerEncoder(
+            num_layers=self.num_layers,
+            num_heads=self.num_heads,
+            head_dim=self.head_dim,
+            mlp_dim=self.mlp_dim,
+            dtype=self.dtype,
+            dropout_rate=self.dropout_rate,
+            pre_norm=False,  # original BERT is post-LN
+            attn_impl=self.attn_impl,
+            remat=self.remat,
+            name="encoder",
+        )
+        self.mlm_ln = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")
+        self.mlm_dense = nn.Dense(
+            self.num_heads * self.head_dim, dtype=self.dtype, name="mlm_dense"
+        )
+        self.mlm_bias = self.param(
+            "mlm_bias", nn.initializers.zeros, (self.vocab_size,), jnp.float32
+        )
+
+    def __call__(self, input_ids, attention_mask=None, *, train: bool = True):
+        seq_len = input_ids.shape[1]
+        x = self.word_embed(input_ids)
+        x = x + self.pos_embed(jnp.arange(seq_len))[None]
+        x = self.embed_ln(x).astype(self.dtype)
+        x = self.dropout(x, deterministic=not train)
+        mask = None
+        if attention_mask is not None:
+            # (B, T) keep-mask -> (B, 1, 1, T) broadcastable over heads/q
+            mask = attention_mask[:, None, None, :].astype(bool)
+        h = self.encoder(x, mask, train=train)
+        # MLM head: transform + tied decoder
+        h = nn.gelu(self.mlm_dense(h))
+        h = self.mlm_ln(h).astype(self.dtype)
+        logits = self.word_embed.attend(h)  # (B, T, vocab), tied weights
+        return logits.astype(jnp.float32) + self.mlm_bias
+
+
+class MlmTask(Task):
+    """Masked-LM objective with on-device dynamic masking.
+
+    ``batch = {"input_ids": int32 (B, T)}``. Each step draws BERT's 15%
+    corruption from the per-step rng: of selected positions 80% become
+    ``[MASK]``, 10% a random token, 10% keep; loss is cross-entropy on
+    selected positions only.
+    """
+
+    MASK_TOKEN = 103  # BERT's [MASK] id
+    mask_rate = 0.15
+
+    def model_inputs(self, batch):
+        return (batch["input_ids"],)
+
+    def _corrupt(self, input_ids, rng, vocab):
+        r_select, r_op, r_tok = jax.random.split(rng, 3)
+        u = jax.random.uniform(r_select, input_ids.shape)
+        selected = u < self.mask_rate
+        op = jax.random.uniform(r_op, input_ids.shape)
+        random_tokens = jax.random.randint(r_tok, input_ids.shape, 0, vocab,
+                                           dtype=input_ids.dtype)
+        corrupted = jnp.where(op < 0.8, self.MASK_TOKEN,
+                              jnp.where(op < 0.9, random_tokens, input_ids))
+        return jnp.where(selected, corrupted, input_ids), selected
+
+    def loss(self, params, extra_vars, batch, rng, *, train=True):
+        input_ids = batch["input_ids"]
+        vocab = self.model.vocab_size
+        if rng is None:  # eval: deterministic masking keyed on nothing
+            rng = jax.random.PRNGKey(0)
+        mask_rng, dropout_rng = jax.random.split(rng)
+        corrupted, selected = self._corrupt(input_ids, mask_rng, vocab)
+
+        variables = {"params": params, **extra_vars}
+        kwargs = {"train": train}
+        if train:
+            kwargs["rngs"] = {"dropout": dropout_rng}
+        logits = self.model.apply(variables, corrupted, **kwargs)
+
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        token_logp = jnp.take_along_axis(
+            logp, input_ids[..., None].astype(jnp.int32), axis=-1
+        )[..., 0]
+        sel = selected.astype(jnp.float32)
+        denom = jnp.maximum(sel.sum(), 1.0)
+        loss = -(token_logp * sel).sum() / denom
+        acc = ((jnp.argmax(logits, -1) == input_ids).astype(jnp.float32)
+               * sel).sum() / denom
+        return loss, extra_vars, {"loss": loss, "mlm_accuracy": acc}
+
+
+def bert_base(dtype=jnp.float32, attn_impl: Impl = "auto", remat: bool = False,
+              seq_len: int = 512, vocab_size: int = 30_522) -> BertEncoder:
+    return BertEncoder(vocab_size=vocab_size, max_len=seq_len, dtype=dtype,
+                       attn_impl=attn_impl, remat=remat)
+
+
+def bert_tiny(dtype=jnp.float32, attn_impl: Impl = "auto",
+              seq_len: int = 128, vocab_size: int = 1024) -> BertEncoder:
+    """Test-sized BERT: 2 layers, 2 heads — CPU-CI fast."""
+    return BertEncoder(vocab_size=vocab_size, max_len=seq_len, num_layers=2,
+                       num_heads=2, head_dim=32, mlp_dim=128, dtype=dtype,
+                       attn_impl=attn_impl)

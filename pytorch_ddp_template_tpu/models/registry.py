@@ -68,14 +68,14 @@ def _mlp_wide(config: TrainingConfig):
     return task, ds
 
 
-def _image_entry(config: TrainingConfig, model_cls, image_size: int,
-                 num_classes: int, stem: str):
+def _image_entry(config: TrainingConfig, model_factory, image_size: int,
+                 num_classes: int):
+    """Classification task + synthetic images; ``model_factory`` takes
+    ``(num_classes, dtype)`` and returns the Flax module."""
     from ..data.dataset import SyntheticImageDataset
     from .task import ClassificationTask
 
-    task = ClassificationTask(
-        model_cls(num_classes=num_classes, dtype=_dtype(config), stem=stem)
-    )
+    task = ClassificationTask(model_factory(num_classes, _dtype(config)))
     ds = SyntheticImageDataset(
         samples=config.dataset_size, image_size=image_size,
         num_classes=num_classes, seed=config.seed,
@@ -88,8 +88,8 @@ def _resnet18(config: TrainingConfig):
     """ResNet-18 / CIFAR-10-shaped data (BASELINE.md ladder rung 2)."""
     from .resnet import ResNet18
 
-    return _image_entry(config, ResNet18, image_size=32, num_classes=10,
-                        stem="cifar")
+    factory = lambda n, dt: ResNet18(num_classes=n, dtype=dt, stem="cifar")
+    return _image_entry(config, factory, image_size=32, num_classes=10)
 
 
 @register("resnet50")
@@ -97,5 +97,51 @@ def _resnet50(config: TrainingConfig):
     """ResNet-50 / ImageNet-shaped data — the BASELINE.json headline config."""
     from .resnet import ResNet50
 
-    return _image_entry(config, ResNet50, image_size=224, num_classes=1000,
-                        stem="imagenet")
+    factory = lambda n, dt: ResNet50(num_classes=n, dtype=dt, stem="imagenet")
+    return _image_entry(config, factory, image_size=224, num_classes=1000)
+
+
+@register("bert-base")
+def _bert_base(config: TrainingConfig):
+    """BERT-base MLM on synthetic 512-token sequences (BASELINE.md rung 4)."""
+    from ..data.dataset import SyntheticTokenDataset
+    from .bert import MlmTask, bert_base
+
+    seq_len, vocab = 512, 30_522
+    task = MlmTask(bert_base(dtype=_dtype(config), seq_len=seq_len,
+                             vocab_size=vocab))
+    ds = SyntheticTokenDataset(samples=config.dataset_size, seq_len=seq_len,
+                               vocab=vocab, seed=config.seed)
+    return task, ds
+
+
+@register("bert-tiny")
+def _bert_tiny(config: TrainingConfig):
+    """2-layer BERT on short synthetic sequences — the CPU-CI language config."""
+    from ..data.dataset import SyntheticTokenDataset
+    from .bert import MlmTask, bert_tiny
+
+    seq_len, vocab = 128, 1024
+    task = MlmTask(bert_tiny(dtype=_dtype(config), seq_len=seq_len,
+                             vocab_size=vocab))
+    ds = SyntheticTokenDataset(samples=config.dataset_size, seq_len=seq_len,
+                               vocab=vocab, seed=config.seed)
+    return task, ds
+
+
+@register("vit-b16")
+def _vit_b16(config: TrainingConfig):
+    """ViT-B/16 / ImageNet-shaped data (BASELINE.md rung 5; bf16 + accum)."""
+    from .vit import vit_b16
+
+    factory = lambda n, dt: vit_b16(num_classes=n, dtype=dt)
+    return _image_entry(config, factory, image_size=224, num_classes=1000)
+
+
+@register("vit-tiny")
+def _vit_tiny(config: TrainingConfig):
+    """2-layer ViT on 32px images — the CPU-CI vision-transformer config."""
+    from .vit import vit_tiny
+
+    factory = lambda n, dt: vit_tiny(num_classes=n, dtype=dt)
+    return _image_entry(config, factory, image_size=32, num_classes=10)

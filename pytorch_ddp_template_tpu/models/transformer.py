@@ -1,0 +1,167 @@
+"""Shared transformer encoder: the backbone of the BERT and ViT rungs.
+
+The reference has no transformer (its zoo is a 2-layer MLP,
+``/root/reference/model.py:8-16``); BASELINE.md's config ladder adds
+BERT-base MLM and ViT-B/16, which share this encoder. TPU-first choices:
+
+- Attention routes through ``ops.attention`` (Pallas flash kernel on TPU,
+  XLA elsewhere); heads/head_dim sized to MXU lanes (head_dim 64/128).
+- Compute dtype configurable (bf16 under ``--bf16``); LayerNorm and
+  softmax statistics stay f32.
+- Weights are stored with *logical axis names* via
+  ``nn.with_logical_partitioning`` — ``parallel/sharding.py`` maps the
+  logical names (``embed``, ``mlp``, ``heads``, ``kv``) onto mesh axes,
+  which is how tensor parallelism turns on without touching model code.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import Impl, attention
+
+default_kernel_init = nn.initializers.normal(stddev=0.02)
+
+
+def _dense(features, dtype, name, logical_axes, kernel_init=None):
+    return nn.DenseGeneral(
+        features,
+        dtype=dtype,
+        kernel_init=nn.with_logical_partitioning(
+            kernel_init or default_kernel_init, logical_axes
+        ),
+        bias_init=nn.with_logical_partitioning(
+            nn.initializers.zeros, logical_axes[1:]
+        ),
+        name=name,
+    )
+
+
+class MultiHeadAttention(nn.Module):
+    """Self-attention with fused-qkv-friendly layout and op dispatch."""
+
+    num_heads: int
+    head_dim: int
+    dtype: jnp.dtype = jnp.float32
+    dropout_rate: float = 0.0
+    attn_impl: Impl = "auto"
+
+    @nn.compact
+    def __call__(self, x, mask=None, *, train: bool = True):
+        features = x.shape[-1]
+        proj = lambda name: nn.DenseGeneral(
+            (self.num_heads, self.head_dim),
+            dtype=self.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                default_kernel_init, ("embed", "heads", "kv")
+            ),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, ("heads", "kv")
+            ),
+            name=name,
+        )
+        q = proj("query")(x)
+        k = proj("key")(x)
+        v = proj("value")(x)
+        out = attention(q, k, v, mask=mask, impl=self.attn_impl)
+        out = nn.DenseGeneral(
+            features,
+            axis=(-2, -1),
+            dtype=self.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                default_kernel_init, ("heads", "kv", "embed")
+            ),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, ("embed",)
+            ),
+            name="out",
+        )(out)
+        if self.dropout_rate:
+            out = nn.Dropout(self.dropout_rate, deterministic=not train)(out)
+        return out
+
+
+class MlpBlock(nn.Module):
+    """Position-wise feed-forward; hidden dim shards over ``mlp``."""
+
+    mlp_dim: int
+    dtype: jnp.dtype = jnp.float32
+    dropout_rate: float = 0.0
+    act: Callable = nn.gelu
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = True):
+        features = x.shape[-1]
+        h = _dense(self.mlp_dim, self.dtype, "fc1", ("embed", "mlp"))(x)
+        h = self.act(h)
+        h = _dense(features, self.dtype, "fc2", ("mlp", "embed"))(h)
+        if self.dropout_rate:
+            h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
+        return h
+
+
+class EncoderBlock(nn.Module):
+    """Pre-LN (ViT) or post-LN (BERT) encoder block."""
+
+    num_heads: int
+    head_dim: int
+    mlp_dim: int
+    dtype: jnp.dtype = jnp.float32
+    dropout_rate: float = 0.0
+    pre_norm: bool = True
+    attn_impl: Impl = "auto"
+
+    @nn.compact
+    def __call__(self, x, mask=None, train: bool = True):
+        # ``train`` is positional (not keyword-only) so nn.remat can pin it
+        # via static_argnums=(3,) — self counts as argnum 0
+        ln = lambda name: nn.LayerNorm(dtype=jnp.float32, name=name)
+        attn = MultiHeadAttention(
+            self.num_heads, self.head_dim, self.dtype,
+            self.dropout_rate, self.attn_impl, name="attention",
+        )
+        mlp = MlpBlock(self.mlp_dim, self.dtype, self.dropout_rate, name="mlp")
+        if self.pre_norm:
+            x = x + attn(ln("ln_attn")(x).astype(self.dtype), mask, train=train)
+            x = x + mlp(ln("ln_mlp")(x).astype(self.dtype), train=train)
+        else:
+            x = ln("ln_attn")(x + attn(x, mask, train=train)).astype(self.dtype)
+            x = ln("ln_mlp")(x + mlp(x, train=train)).astype(self.dtype)
+        return x
+
+
+class TransformerEncoder(nn.Module):
+    """Stack of encoder blocks with optional remat.
+
+    ``remat`` applies ``nn.remat`` (jax.checkpoint) per block — trading
+    FLOPs for HBM, the standard TPU recipe for deep/long-sequence configs.
+    """
+
+    num_layers: int
+    num_heads: int
+    head_dim: int
+    mlp_dim: int
+    dtype: jnp.dtype = jnp.float32
+    dropout_rate: float = 0.0
+    pre_norm: bool = True
+    attn_impl: Impl = "auto"
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, x, mask=None, *, train: bool = True):
+        block_cls = EncoderBlock
+        if self.remat:
+            block_cls = nn.remat(EncoderBlock, static_argnums=(3,))
+        for layer in range(self.num_layers):
+            block = block_cls(
+                self.num_heads, self.head_dim, self.mlp_dim, self.dtype,
+                self.dropout_rate, self.pre_norm, self.attn_impl,
+                name=f"layer_{layer}",
+            )
+            x = block(x, mask, train) if self.remat else block(
+                x, mask, train=train)
+        return x

@@ -1,0 +1,98 @@
+"""ViT-B/16 — the final rung of the BASELINE.md config ladder.
+
+No counterpart in the reference (zoo = one MLP,
+``/root/reference/model.py:8-16``); BASELINE.md rung 5 is "ViT-B/16 /
+ImageNet, bf16 + grad accumulation". TPU-first choices:
+
+- Patchify as a single strided Conv (16x16/s16) — one big NHWC conv the
+  MXU eats directly; tokens stay ``(B, 196+1, 768)``, all matmul-shaped.
+- Pre-LN encoder from ``models/transformer.py`` (flash attention on TPU,
+  bf16 compute / f32 norms under ``--bf16``).
+- Classification token + learned position embeddings, mean-free head:
+  take the class token, LayerNorm, Dense — logits in f32.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.attention import Impl
+from .transformer import TransformerEncoder, default_kernel_init
+
+
+class VisionTransformer(nn.Module):
+    num_classes: int = 1000
+    patch_size: int = 16
+    num_layers: int = 12
+    num_heads: int = 12
+    head_dim: int = 64
+    mlp_dim: int = 3072
+    dtype: jnp.dtype = jnp.float32
+    dropout_rate: float = 0.0
+    attn_impl: Impl = "auto"
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = True):
+        embed_dim = self.num_heads * self.head_dim
+        b, h, w, c = x.shape
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            embed_dim,
+            (self.patch_size, self.patch_size),
+            strides=(self.patch_size, self.patch_size),
+            padding="VALID",
+            dtype=self.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                default_kernel_init, (None, None, None, "embed")
+            ),
+            name="patch_embed",
+        )(x)
+        x = x.reshape(b, -1, embed_dim)  # (B, tokens, E)
+
+        cls = self.param(
+            "cls_token", nn.initializers.zeros, (1, 1, embed_dim), jnp.float32
+        )
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls.astype(self.dtype), (b, 1, embed_dim)), x],
+            axis=1,
+        )
+        pos = self.param(
+            "pos_embed", default_kernel_init, (1, x.shape[1], embed_dim),
+            jnp.float32,
+        )
+        x = x + pos.astype(self.dtype)
+        if self.dropout_rate:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+
+        x = TransformerEncoder(
+            num_layers=self.num_layers,
+            num_heads=self.num_heads,
+            head_dim=self.head_dim,
+            mlp_dim=self.mlp_dim,
+            dtype=self.dtype,
+            dropout_rate=self.dropout_rate,
+            pre_norm=True,
+            attn_impl=self.attn_impl,
+            remat=self.remat,
+            name="encoder",
+        )(x, train=train)
+
+        x = nn.LayerNorm(dtype=jnp.float32, name="final_ln")(x[:, 0])
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+def vit_b16(num_classes=1000, dtype=jnp.float32, attn_impl: Impl = "auto",
+            remat: bool = False, **kw) -> VisionTransformer:
+    return VisionTransformer(num_classes=num_classes, dtype=dtype,
+                             attn_impl=attn_impl, remat=remat, **kw)
+
+
+def vit_tiny(num_classes=10, dtype=jnp.float32, attn_impl: Impl = "auto",
+             **kw) -> VisionTransformer:
+    """Test-sized ViT: 32px/8px patches, 2 layers — CPU-CI fast."""
+    return VisionTransformer(num_classes=num_classes, patch_size=8,
+                             num_layers=2, num_heads=2, head_dim=32,
+                             mlp_dim=128, dtype=dtype, attn_impl=attn_impl,
+                             **kw)

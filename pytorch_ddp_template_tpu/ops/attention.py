@@ -1,0 +1,189 @@
+"""Attention ops: the hot kernel of the transformer rungs (BERT, ViT).
+
+The reference has no attention anywhere (its model is a 2-layer MLP,
+``/root/reference/model.py:8-16``) — but the BASELINE.md config ladder
+(BERT-base MLM, ViT-B/16) makes attention the dominant op of two of the
+four target configs, so it gets a first-class TPU-native op library:
+
+- ``dot_product_attention``: plain XLA einsum formulation. For moderate
+  sequence lengths XLA already fuses this well onto the MXU; softmax runs
+  in f32 regardless of compute dtype.
+- ``blockwise_attention``: memory-efficient online-softmax formulation
+  (Rabe & Staats / FlashAttention recurrence) expressed with ``lax.scan``
+  over key/value blocks — O(block) memory instead of O(seq^2), fully
+  differentiable (XLA differentiates the scan), and the exact building
+  block ring attention shards over the ``seq`` mesh axis
+  (``parallel/ring.py``).
+- ``flash_attention``: Pallas TPU kernel (``ops/flash.py``) — fused
+  tiled kernel keeping the running softmax state in VMEM.
+
+``attention(..., impl="auto")`` picks per backend: Pallas on TPU, XLA
+elsewhere.
+"""
+
+from __future__ import annotations
+
+from typing import Literal
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Impl = Literal["auto", "xla", "blockwise", "flash"]
+
+NEG_INF = -1e30  # additive mask value; finite so 0*inf NaNs can't appear
+
+
+def _pick_impl(impl: Impl, q: jax.Array) -> str:
+    if impl != "auto":
+        return impl
+    if jax.default_backend() == "tpu":
+        # Pallas wants sublane-aligned head_dim (64 packs two rows per
+        # vreg; 128 is native) and a seq_len that leaves a >=128 block
+        # after the wrapper's divisor-fitting (flash.py picks
+        # gcd(seq, block_size) as the block).
+        head_dim, seq = q.shape[-1], q.shape[-3]
+        if head_dim % 64 == 0 and seq % 128 == 0:
+            return "flash"
+    return "xla"
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mask: jax.Array | None = None,
+    causal: bool = False,
+    impl: Impl = "auto",
+    block_size: int = 512,
+) -> jax.Array:
+    """Multi-head scaled dot-product attention.
+
+    Args:
+      q, k, v: ``(batch, seq, heads, head_dim)``.
+      mask: optional boolean ``(batch, 1|heads, q_seq, kv_seq)``; True keeps.
+      causal: apply a causal mask (combined with ``mask`` if both given).
+      impl: implementation selector (see module docstring).
+      block_size: kv-block length for the blockwise/flash paths.
+
+    Returns ``(batch, seq, heads, head_dim)`` in the dtype of ``q``.
+    """
+    chosen = _pick_impl(impl, q)
+    if chosen == "xla":
+        return dot_product_attention(q, k, v, mask=mask, causal=causal)
+    if chosen == "blockwise":
+        return blockwise_attention(q, k, v, mask=mask, causal=causal,
+                                   block_size=block_size)
+    if chosen == "flash":
+        from .flash import flash_attention
+
+        return flash_attention(q, k, v, mask=mask, causal=causal,
+                               block_size=min(block_size, q.shape[1]))
+    raise ValueError(f"unknown attention impl {chosen!r}")
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mask: jax.Array | None = None,
+    causal: bool = False,
+) -> jax.Array:
+    """Reference XLA formulation; softmax in f32."""
+    dtype = q.dtype
+    head_dim = q.shape[-1]
+    scale = head_dim ** -0.5
+    # (B, S, H, D) x (B, T, H, D) -> (B, H, S, T)
+    logits = jnp.einsum("bshd,bthd->bhst", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    logits = _apply_masks(logits, mask, causal)
+    weights = jax.nn.softmax(logits, axis=-1).astype(dtype)
+    return jnp.einsum("bhst,bthd->bshd", weights, v)
+
+
+def _apply_masks(logits: jax.Array, mask: jax.Array | None, causal: bool,
+                 q_offset: int | jax.Array = 0) -> jax.Array:
+    """Additive-mask ``(B, H, S, T)`` logits. ``q_offset`` shifts query
+    positions (used by blockwise/ring where q is a chunk of a longer seq)."""
+    if causal:
+        s, t = logits.shape[-2], logits.shape[-1]
+        q_pos = q_offset + lax.broadcasted_iota(jnp.int32, (s, t), 0)
+        k_pos = lax.broadcasted_iota(jnp.int32, (s, t), 1)
+        logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+    if mask is not None:
+        logits = jnp.where(mask, logits, NEG_INF)
+    return logits
+
+
+def blockwise_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mask: jax.Array | None = None,
+    causal: bool = False,
+    block_size: int = 512,
+) -> jax.Array:
+    """Online-softmax attention scanning over kv blocks.
+
+    Maintains the FlashAttention running state per query: max logit ``m``,
+    normaliser ``l``, and unnormalised accumulator ``acc``; each kv block
+    updates the state with the standard rescaling recurrence. Memory is
+    O(seq * block) instead of O(seq^2), which is what makes million-token
+    sequences feasible; the same recurrence consumes remote kv blocks in
+    ring attention.
+    """
+    dtype = q.dtype
+    b, s, h, d = q.shape
+    t = k.shape[1]
+    block = min(block_size, t)
+    if t % block:
+        raise ValueError(f"kv seq {t} not divisible by block {block}")
+    n_blocks = t // block
+    scale = d ** -0.5
+
+    qf = (q.astype(jnp.float32) * scale).transpose(0, 2, 1, 3)  # (B,H,S,D)
+    kb = k.astype(jnp.float32).reshape(b, n_blocks, block, h, d)
+    vb = v.astype(jnp.float32).reshape(b, n_blocks, block, h, d)
+    mb = None
+    if mask is not None:
+        mask = jnp.broadcast_to(mask, (b, mask.shape[1], s, t))
+        mb = mask.reshape(b, mask.shape[1], s, n_blocks, block)
+
+    def body(carry, inp):
+        m, l, acc = carry
+        (i, kblk, vblk) = inp
+        kblk = kblk.transpose(0, 2, 1, 3)  # (B,H,block,D)
+        vblk = vblk.transpose(0, 2, 1, 3)
+        logits = jnp.einsum("bhsd,bhtd->bhst", qf, kblk)  # (B,H,S,block)
+        if causal:
+            q_pos = lax.broadcasted_iota(jnp.int32, (s, block), 0)
+            k_pos = i * block + lax.broadcasted_iota(jnp.int32, (s, block), 1)
+            logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+        if mb is not None:
+            blk_mask = lax.dynamic_index_in_dim(mb, i, axis=3, keepdims=False)
+            logits = jnp.where(blk_mask, logits, NEG_INF)
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        p = jnp.exp(logits - m_new[..., None])
+        correction = jnp.exp(m - m_new)
+        l_new = l * correction + p.sum(axis=-1)
+        acc_new = acc * correction[..., None] + jnp.einsum(
+            "bhst,bhtd->bhsd", p, vblk
+        )
+        return (m_new, l_new, acc_new), None
+
+    m0 = jnp.full((b, h, s), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s), jnp.float32)
+    acc0 = jnp.zeros((b, h, s, d), jnp.float32)
+    ks = jnp.moveaxis(kb, 1, 0)  # (n_blocks, B, block, H, D) for scan
+    vs = jnp.moveaxis(vb, 1, 0)
+    (m, l, acc), _ = lax.scan(body, (m0, l0, acc0),
+                              (jnp.arange(n_blocks), ks, vs))
+    # fully-masked rows produce 0 output, not NaN: their running max never
+    # left the NEG_INF floor (p degenerates to exp(0)=1 there, so l>0 and
+    # acc would otherwise average v)
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    out = jnp.where((m <= NEG_INF / 2)[..., None], 0.0, out)
+    return out.transpose(0, 2, 1, 3).astype(dtype)

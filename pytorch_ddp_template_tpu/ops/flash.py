@@ -1,0 +1,223 @@
+"""Pallas TPU flash attention: fused tiled attention for the hot path.
+
+The reference's native-code surface is third-party CUDA (NCCL/apex,
+SURVEY.md §2c); the equivalent move on TPU is a Pallas kernel for the one
+op where hand-tiling beats stock XLA: attention over long sequences.
+
+Design (FlashAttention recurrence, TPU-shaped):
+
+- Grid ``(batch, heads, q_blocks, kv_blocks)``; the kv dimension is
+  ``arbitrary`` (sequential) so the running softmax state lives in VMEM
+  scratch across kv iterations, while batch/head/q blocks parallelise.
+- Running state per q row: max ``m``, normaliser ``l`` (stored
+  lane-replicated ``(block_q, 128)`` — TPU vregs are 2D, scalars-per-row
+  are cheapest as a replicated lane vector), accumulator ``acc``
+  ``(block_q, head_dim)`` in f32.
+- Logits/softmax in f32 on the MXU (``preferred_element_type``), output
+  cast back to the input dtype (bf16 in the bf16 configs).
+- Causal blocks that are fully masked are skipped (work scales with the
+  triangle, not the square); the final kv iteration writes
+  ``out = acc / l`` and the logsumexp.
+- Backward: ``custom_vjp`` with the saved logsumexp; recomputes logits
+  blockwise with a ``lax.scan`` (O(block) memory) and applies the standard
+  flash backward formulas — no O(seq^2) residuals anywhere.
+
+``interpret=True`` (automatic off-TPU) runs the same kernel through the
+Pallas interpreter, which is how CPU CI validates numerics.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+LANES = 128
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, scale: float, causal: bool, block_q: int, block_kv: int,
+                kv_blocks: int):
+    i = pl.program_id(2)  # q block
+    j = pl.program_id(3)  # kv block (sequential)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # causal: kv block strictly above the diagonal touches no valid pair
+    needed = (j * block_kv <= (i + 1) * block_q - 1) if causal else True
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale          # (bq, d)
+        k = k_ref[0, 0].astype(jnp.float32)                  # (bkv, d)
+        v = v_ref[0, 0].astype(jnp.float32)                  # (bkv, d)
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # (bq, bkv)
+        if causal:
+            q_pos = i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            k_pos = j * block_kv + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+        m_prev = m_ref[...]                                   # (bq, LANES)
+        l_prev = l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new[:, :1])                         # (bq, bkv)
+        correction = jnp.exp(m_prev - m_new)                  # (bq, LANES)
+        l_ref[...] = l_prev * correction + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[...] = m_new
+        pv = lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)  # (bq, d)
+        acc_ref[...] = acc_ref[...] * correction[:, :1] + pv
+
+    @pl.when(j == kv_blocks - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        o_ref[0, 0] = (acc_ref[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        lse = m_ref[:, :1] + jnp.log(jnp.maximum(l, 1e-30))
+        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
+
+
+def _fwd_pallas(q, k, v, *, causal: bool, block_q: int, block_kv: int,
+                interpret: bool):
+    """(B,H,S,D) inputs -> (out, lse); lse is (B,H,S,LANES) lane-replicated."""
+    b, h, s, d = q.shape
+    t = k.shape[2]
+    block_q = min(block_q, s)
+    block_kv = min(block_kv, t)
+    if s % block_q or t % block_kv:
+        raise ValueError(f"seq {s}/{t} not divisible by blocks {block_q}/{block_kv}")
+    grid = (b, h, s // block_q, t // block_kv)
+    kernel = functools.partial(
+        _fwd_kernel, scale=d ** -0.5, causal=causal,
+        block_q=block_q, block_kv=block_kv, kv_blocks=grid[3],
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_kv, d), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_kv, d), lambda b, h, i, j: (b, h, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, s, LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),      # acc
+            pltpu.VMEM((block_q, LANES), jnp.float32),  # m
+            pltpu.VMEM((block_q, LANES), jnp.float32),  # l
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse[..., 0]
+
+
+def _bwd_blockwise(res, do, *, causal: bool, block_kv: int):
+    """Flash backward via lax.scan over kv blocks (O(block) memory)."""
+    q, k, v, out, lse = res  # q,k,v,out: (B,H,S,D); lse: (B,H,S)
+    b, h, s, d = q.shape
+    t = k.shape[2]
+    block = min(block_kv, t)
+    n = t // block
+    scale = d ** -0.5
+
+    qf = q.astype(jnp.float32) * scale
+    dof = do.astype(jnp.float32)
+    # delta_i = sum_d do_i * out_i  (rowwise), standard flash-bwd shortcut
+    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1)  # (B,H,S)
+
+    kb = jnp.moveaxis(k.astype(jnp.float32).reshape(b, h, n, block, d), 2, 0)
+    vb = jnp.moveaxis(v.astype(jnp.float32).reshape(b, h, n, block, d), 2, 0)
+
+    def body(dq_acc, inp):
+        idx, kblk, vblk = inp  # kblk/vblk: (B,H,block,D)
+        logits = jnp.einsum("bhsd,bhtd->bhst", qf, kblk)
+        if causal:
+            q_pos = lax.broadcasted_iota(jnp.int32, (s, block), 0)
+            k_pos = idx * block + lax.broadcasted_iota(jnp.int32, (s, block), 1)
+            logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+        p = jnp.exp(logits - lse[..., None])                  # (B,H,S,block)
+        dv = jnp.einsum("bhst,bhsd->bhtd", p, dof)
+        dp = jnp.einsum("bhsd,bhtd->bhst", dof, vblk)
+        ds = p * (dp - delta[..., None])                      # (B,H,S,block)
+        dq_acc = dq_acc + jnp.einsum("bhst,bhtd->bhsd", ds, kblk) * scale
+        dk = jnp.einsum("bhst,bhsd->bhtd", ds, qf)            # scale in qf
+        return dq_acc, (dk, dv)
+
+    dq0 = jnp.zeros((b, h, s, d), jnp.float32)
+    dq, (dks, dvs) = lax.scan(body, dq0, (jnp.arange(n), kb, vb))
+    dk = jnp.moveaxis(dks, 0, 2).reshape(b, h, t, d)
+    dv = jnp.moveaxis(dvs, 0, 2).reshape(b, h, t, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_kv, interpret):
+    out, _ = _fwd_pallas(q, k, v, causal=causal, block_q=block_q,
+                         block_kv=block_kv, interpret=interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_kv, interpret):
+    out, lse = _fwd_pallas(q, k, v, causal=causal, block_q=block_q,
+                           block_kv=block_kv, interpret=interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, block_q, block_kv, interpret, res, do):
+    return _bwd_blockwise(res, do, causal=causal, block_kv=block_kv)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mask: jax.Array | None = None,
+    causal: bool = False,
+    block_size: int = 512,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Flash attention on ``(batch, seq, heads, head_dim)`` inputs.
+
+    Arbitrary boolean masks fall back to the blockwise XLA path (the Pallas
+    kernel handles the causal structure natively; a general mask defeats
+    its block-skipping).
+    """
+    if mask is not None:
+        from .attention import blockwise_attention
+
+        return blockwise_attention(q, k, v, mask=mask, causal=causal,
+                                   block_size=block_size)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    # fit blocks to the sequence: gcd keeps them divisors, so any
+    # 128-multiple seq_len works (e.g. seq 768, block 512 -> 256)
+    block_q = math.gcd(q.shape[1], block_size)
+    block_kv = math.gcd(k.shape[1], block_size)
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    out = _flash(qt, kt, vt, causal, block_q, block_kv, interpret)
+    return out.transpose(0, 2, 1, 3)

@@ -1,0 +1,87 @@
+"""Attention op numerics: blockwise and Pallas-flash (interpret mode on
+CPU) against the plain XLA formulation, forward + backward.
+
+The reference has no attention op to compare against (SURVEY.md §5.7); the
+XLA einsum path is the ground truth here.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_ddp_template_tpu.ops.attention import (
+    blockwise_attention,
+    dot_product_attention,
+)
+from pytorch_ddp_template_tpu.ops.flash import flash_attention
+
+B, S, H, D = 1, 64, 2, 32
+
+
+@pytest.fixture(scope="module")
+def qkv():
+    rng = np.random.default_rng(0)
+    return tuple(
+        jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+        for _ in range(3)
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_blockwise_matches_reference(qkv, causal):
+    q, k, v = qkv
+    ref = dot_product_attention(q, k, v, causal=causal)
+    blk = blockwise_attention(q, k, v, causal=causal, block_size=16)
+    np.testing.assert_allclose(ref, blk, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_reference(qkv, causal):
+    q, k, v = qkv
+    ref = dot_product_attention(q, k, v, causal=causal)
+    fl = flash_attention(q, k, v, causal=causal, block_size=32)
+    np.testing.assert_allclose(ref, fl, atol=2e-5)
+
+
+def test_flash_gradients_match(qkv):
+    q, k, v = qkv
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
+
+    ref_fn = loss(lambda q, k, v: dot_product_attention(q, k, v, causal=True))
+    fl_fn = loss(
+        lambda q, k, v: flash_attention(q, k, v, causal=True, block_size=32)
+    )
+    g_ref = jax.grad(ref_fn, argnums=(0, 1, 2))(q, k, v)
+    g_fl = jax.grad(fl_fn, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_fl):
+        scale = float(jnp.abs(a).max())
+        np.testing.assert_allclose(a, b, atol=1e-5 * max(scale, 1.0))
+
+
+def test_padding_mask_blockwise(qkv):
+    q, k, v = qkv
+    keep = jnp.arange(S) < S // 2  # mask out the second half of kv
+    mask = jnp.broadcast_to(keep[None, None, None, :], (B, 1, S, S))
+    ref = dot_product_attention(q, k, v, mask=mask)
+    blk = blockwise_attention(q, k, v, mask=mask, block_size=16)
+    np.testing.assert_allclose(ref, blk, atol=2e-5)
+    # masked-out kv must not influence the output
+    k2 = k.at[:, S // 2 :].set(123.0)
+    v2 = v.at[:, S // 2 :].set(-7.0)
+    ref2 = dot_product_attention(q, k2, v2, mask=mask)
+    np.testing.assert_allclose(ref, ref2, atol=2e-5)
+
+
+def test_fully_masked_rows_zero_not_nan():
+    rng = np.random.default_rng(1)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((1, 8, 1, 8)), jnp.float32)
+        for _ in range(3)
+    )
+    mask = jnp.zeros((1, 1, 8, 8), bool)
+    out = blockwise_attention(q, k, v, mask=mask, block_size=4)
+    assert not bool(jnp.isnan(out).any())
+    np.testing.assert_allclose(out, jnp.zeros_like(out), atol=1e-6)
