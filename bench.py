@@ -89,7 +89,7 @@ def main() -> None:
         rng=jax.random.clone(seed_key),
     )
     state = jax.device_put(state, NamedSharding(mesh, P()))
-    train_step = make_train_step(task, tx, schedule, ctx, accum_steps=1)
+    train_step = make_train_step(task, tx, schedule, accum_steps=1)
 
     # Sync by fetching a real value: on some PJRT transports (e.g. the axon
     # tunnel) block_until_ready can return before compute has finished,

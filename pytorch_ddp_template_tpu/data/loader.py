@@ -26,7 +26,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..runtime.context import DATA_AXIS
+from ..runtime.context import DATA_AXIS, SEQ_AXIS
 from .dataset import Dataset
 from .sampler import epoch_batches, shard_indices
 
@@ -45,6 +45,7 @@ class ShardedLoader:
         drop_last_batch: bool = True,
         prefetch: int = 2,
         accum_steps: int = 1,
+        seq_dims: Mapping[str, int] | None = None,
     ):
         self.dataset = dataset
         self.mesh = mesh
@@ -89,10 +90,47 @@ class ShardedLoader:
         # host and sharded over the *micro* dim — the in-jit lax.scan then
         # walks the leading dim with zero resharding (SURVEY.md §7 hard
         # part (b): accumulation inside jit without recompilation).
-        if self.accum_steps > 1:
-            self._sharding = NamedSharding(mesh, P(None, DATA_AXIS))
-        else:
-            self._sharding = NamedSharding(mesh, P(DATA_AXIS))
+        self._seq_dims = dict(seq_dims or {})
+        self._seq_size = mesh.shape.get(SEQ_AXIS, 1)
+        self._shardings: dict[tuple[str, int], NamedSharding] = {}
+        # If the seq axis spans processes, each process must hand
+        # make_array_from_process_local_data only ITS seq block (the
+        # sampler shards the batch dim; nothing else slices seq). Compute
+        # this process's contiguous seq-coordinate range once.
+        self._seq_block: tuple[int, int] | None = None  # (lo, hi) coords
+        if self._seq_size > 1:
+            axis_idx = mesh.axis_names.index(SEQ_AXIS)
+            local_coords = sorted(
+                {
+                    idx[axis_idx]
+                    for idx, d in np.ndenumerate(mesh.devices)
+                    if d.process_index == self._proc
+                }
+            )
+            if len(local_coords) < self._seq_size:
+                lo, hi = local_coords[0], local_coords[-1] + 1
+                if local_coords != list(range(lo, hi)):
+                    raise ValueError(
+                        "seq mesh axis spans this process non-contiguously "
+                        f"({local_coords}); lay the mesh out so each host's "
+                        "seq shards are adjacent"
+                    )
+                self._seq_block = (lo, hi)
+
+    def _sharding_for(self, key: str, ndim: int) -> NamedSharding:
+        """Per-array sharding: batch dim over ``data``; for sequence keys
+        (context parallelism) the sequence dim additionally over ``seq``."""
+        cached = self._shardings.get((key, ndim))
+        if cached is not None:
+            return cached
+        lead = 1 if self.accum_steps > 1 else 0  # accum dim is unsharded
+        dims: list[str | None] = [None] * ndim
+        dims[lead] = DATA_AXIS
+        if self._seq_size > 1 and key in self._seq_dims:
+            dims[lead + self._seq_dims[key]] = SEQ_AXIS
+        sharding = NamedSharding(self.mesh, P(*dims))
+        self._shardings[(key, ndim)] = sharding
+        return sharding
 
     @property
     def steps_per_epoch(self) -> int:
@@ -118,7 +156,14 @@ class ShardedLoader:
         for k, v in local.items():
             if self.accum_steps > 1:
                 v = v.reshape(self.accum_steps, -1, *v.shape[1:])
-            out[k] = jax.make_array_from_process_local_data(self._sharding, v)
+            if self._seq_block is not None and k in self._seq_dims:
+                dim = self._seq_dims[k] + (1 if self.accum_steps > 1 else 0)
+                block = v.shape[dim] // self._seq_size
+                lo, hi = self._seq_block
+                v = np.take(v, np.arange(lo * block, hi * block), axis=dim)
+            out[k] = jax.make_array_from_process_local_data(
+                self._sharding_for(k, v.ndim), v
+            )
         return out
 
     def epoch(self, epoch: int, start_batch: int = 0) -> Iterator[dict[str, jax.Array]]:

@@ -22,7 +22,6 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from ..ops.attention import Impl
 from .task import Task
 from .transformer import TransformerEncoder, default_kernel_init
 
@@ -39,7 +38,8 @@ class BertEncoder(nn.Module):
     mlp_dim: int = 3072
     dtype: jnp.dtype = jnp.float32
     dropout_rate: float = 0.1
-    attn_impl: Impl = "auto"
+    attn_impl: str = "auto"  # Impl | "ring" (context parallelism)
+    mesh: jax.sharding.Mesh | None = None
     remat: bool = False
 
     def setup(self):
@@ -68,6 +68,7 @@ class BertEncoder(nn.Module):
             dropout_rate=self.dropout_rate,
             pre_norm=False,  # original BERT is post-LN
             attn_impl=self.attn_impl,
+            mesh=self.mesh,
             remat=self.remat,
             name="encoder",
         )
@@ -108,6 +109,9 @@ class MlmTask(Task):
 
     MASK_TOKEN = 103  # BERT's [MASK] id
     mask_rate = 0.15
+    #: sequence dim of each batch key — the loader shards it over the
+    #: ``seq`` mesh axis when context parallelism is on
+    seq_dims = {"input_ids": 1}
 
     def model_inputs(self, batch):
         return (batch["input_ids"],)
@@ -149,13 +153,30 @@ class MlmTask(Task):
         return loss, extra_vars, {"loss": loss, "mlm_accuracy": acc}
 
 
-def bert_base(dtype=jnp.float32, attn_impl: Impl = "auto", remat: bool = False,
-              seq_len: int = 512, vocab_size: int = 30_522) -> BertEncoder:
+def bert_base(dtype=jnp.float32, attn_impl: str = "auto", remat: bool = False,
+              seq_len: int = 512, vocab_size: int = 30_522,
+              mesh=None) -> BertEncoder:
     return BertEncoder(vocab_size=vocab_size, max_len=seq_len, dtype=dtype,
-                       attn_impl=attn_impl, remat=remat)
+                       attn_impl=attn_impl, mesh=mesh, remat=remat)
 
 
-def bert_tiny(dtype=jnp.float32, attn_impl: Impl = "auto",
+def bert_long(seq_len: int = 4096, dtype=jnp.float32, mesh=None,
+              vocab_size: int = 30_522, **size_overrides) -> BertEncoder:
+    """Long-context BERT: ring attention over the ``seq`` mesh axis when
+    present (falls back to single-chip blockwise attention otherwise),
+    remat per block. The long-context capability rung (SURVEY.md §5.7
+    notes the reference has none; here it is first-class).
+
+    ``size_overrides`` (num_layers, num_heads, ...) scale the encoder —
+    the CI-sized registry entry shares this ring-eligibility logic."""
+    ring = bool(mesh) and mesh.shape.get("seq", 1) > 1
+    return BertEncoder(vocab_size=vocab_size, max_len=seq_len, dtype=dtype,
+                       attn_impl="ring" if ring else "blockwise",
+                       mesh=mesh if ring else None, remat=True,
+                       **size_overrides)
+
+
+def bert_tiny(dtype=jnp.float32, attn_impl: str = "auto",
               seq_len: int = 128, vocab_size: int = 1024) -> BertEncoder:
     """Test-sized BERT: 2 layers, 2 heads — CPU-CI fast."""
     return BertEncoder(vocab_size=vocab_size, max_len=seq_len, num_layers=2,

@@ -30,13 +30,20 @@ def available_models() -> list[str]:
     return sorted(_REGISTRY)
 
 
-def build(name: str, config: TrainingConfig) -> tuple[Task, Dataset]:
+def build(name: str, config: TrainingConfig, mesh=None) -> tuple[Task, Dataset]:
+    """Build (task, dataset). ``mesh`` is consumed by entries that embed
+    mesh-dependent ops (ring attention); omitted, those entries construct
+    one from ``config.mesh`` over all devices."""
+    import inspect
+
     try:
         factory = _REGISTRY[name]
     except KeyError:
         raise ValueError(
             f"unknown model {name!r}; available: {available_models()}"
         ) from None
+    if "mesh" in inspect.signature(factory).parameters:
+        return factory(config, mesh=mesh)
     return factory(config)
 
 
@@ -145,3 +152,44 @@ def _vit_tiny(config: TrainingConfig):
 
     factory = lambda n, dt: vit_tiny(num_classes=n, dtype=dt)
     return _image_entry(config, factory, image_size=32, num_classes=10)
+
+
+@register("bert-long")
+def _bert_long(config: TrainingConfig, mesh=None):
+    """Long-context BERT (4096 tokens): ring attention over the ``seq``
+    mesh axis when the mesh has one — the context-parallel rung."""
+    from ..data.dataset import SyntheticTokenDataset
+    from ..runtime import make_mesh
+    from .bert import MlmTask, bert_long
+
+    import jax
+
+    if mesh is None:
+        mesh = make_mesh(config.mesh, jax.devices())
+    seq_len, vocab = 4096, 30_522
+    task = MlmTask(bert_long(seq_len=seq_len, dtype=_dtype(config), mesh=mesh,
+                             vocab_size=vocab))
+    ds = SyntheticTokenDataset(samples=config.dataset_size, seq_len=seq_len,
+                               vocab=vocab, seed=config.seed)
+    return task, ds
+
+
+@register("bert-long-tiny")
+def _bert_long_tiny(config: TrainingConfig, mesh=None):
+    """Test-sized long-context config: 2-layer BERT, 512 tokens, ring
+    attention when the mesh has a ``seq`` axis (CPU-CI exercisable)."""
+    from ..data.dataset import SyntheticTokenDataset
+    from ..runtime import make_mesh
+    from .bert import MlmTask, bert_long
+
+    import jax
+
+    if mesh is None:
+        mesh = make_mesh(config.mesh, jax.devices())
+    seq_len, vocab = 512, 1024
+    task = MlmTask(bert_long(seq_len=seq_len, dtype=_dtype(config), mesh=mesh,
+                             vocab_size=vocab, num_layers=2, num_heads=2,
+                             head_dim=32, mlp_dim=128))
+    ds = SyntheticTokenDataset(samples=config.dataset_size, seq_len=seq_len,
+                               vocab=vocab, seed=config.seed)
+    return task, ds

@@ -42,13 +42,19 @@ def _dense(features, dtype, name, logical_axes, kernel_init=None):
 
 
 class MultiHeadAttention(nn.Module):
-    """Self-attention with fused-qkv-friendly layout and op dispatch."""
+    """Self-attention with fused-qkv-friendly layout and op dispatch.
+
+    ``attn_impl="ring"`` runs ring attention over the ``seq`` mesh axis
+    (context parallelism for long sequences, ``parallel/ring.py``);
+    ``mesh`` must then be set (threaded from the encoder).
+    """
 
     num_heads: int
     head_dim: int
     dtype: jnp.dtype = jnp.float32
     dropout_rate: float = 0.0
-    attn_impl: Impl = "auto"
+    attn_impl: str = "auto"  # Impl | "ring"
+    mesh: jax.sharding.Mesh | None = None
 
     @nn.compact
     def __call__(self, x, mask=None, *, train: bool = True):
@@ -67,7 +73,14 @@ class MultiHeadAttention(nn.Module):
         q = proj("query")(x)
         k = proj("key")(x)
         v = proj("value")(x)
-        out = attention(q, k, v, mask=mask, impl=self.attn_impl)
+        if self.attn_impl == "ring":
+            if self.mesh is None:
+                raise ValueError("attn_impl='ring' requires mesh")
+            from ..parallel.ring import ring_attention
+
+            out = ring_attention(q, k, v, self.mesh)
+        else:
+            out = attention(q, k, v, mask=mask, impl=self.attn_impl)
         out = nn.DenseGeneral(
             features,
             axis=(-2, -1),
@@ -113,7 +126,8 @@ class EncoderBlock(nn.Module):
     dtype: jnp.dtype = jnp.float32
     dropout_rate: float = 0.0
     pre_norm: bool = True
-    attn_impl: Impl = "auto"
+    attn_impl: str = "auto"
+    mesh: jax.sharding.Mesh | None = None
 
     @nn.compact
     def __call__(self, x, mask=None, train: bool = True):
@@ -122,7 +136,7 @@ class EncoderBlock(nn.Module):
         ln = lambda name: nn.LayerNorm(dtype=jnp.float32, name=name)
         attn = MultiHeadAttention(
             self.num_heads, self.head_dim, self.dtype,
-            self.dropout_rate, self.attn_impl, name="attention",
+            self.dropout_rate, self.attn_impl, self.mesh, name="attention",
         )
         mlp = MlpBlock(self.mlp_dim, self.dtype, self.dropout_rate, name="mlp")
         if self.pre_norm:
@@ -148,7 +162,8 @@ class TransformerEncoder(nn.Module):
     dtype: jnp.dtype = jnp.float32
     dropout_rate: float = 0.0
     pre_norm: bool = True
-    attn_impl: Impl = "auto"
+    attn_impl: str = "auto"
+    mesh: jax.sharding.Mesh | None = None
     remat: bool = False
 
     @nn.compact
@@ -159,7 +174,7 @@ class TransformerEncoder(nn.Module):
         for layer in range(self.num_layers):
             block = block_cls(
                 self.num_heads, self.head_dim, self.mlp_dim, self.dtype,
-                self.dropout_rate, self.pre_norm, self.attn_impl,
+                self.dropout_rate, self.pre_norm, self.attn_impl, self.mesh,
                 name=f"layer_{layer}",
             )
             x = block(x, mask, train) if self.remat else block(

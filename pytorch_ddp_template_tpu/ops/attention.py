@@ -117,6 +117,65 @@ def _apply_masks(logits: jax.Array, mask: jax.Array | None, causal: bool,
     return logits
 
 
+def online_softmax_update(
+    state: tuple[jax.Array, jax.Array, jax.Array],
+    qf: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    q_offset: jax.Array | int = 0,
+    k_offset: jax.Array | int = 0,
+    causal: bool = False,
+    mask_block: jax.Array | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One step of the online-softmax recurrence over a kv chunk.
+
+    The shared core of ``blockwise_attention`` (scan over local kv blocks)
+    and ``parallel.ring.ring_attention`` (scan over *remote* kv chunks
+    arriving via ``ppermute``). Positions are absolute: ``q_offset`` /
+    ``k_offset`` locate the chunks inside the full sequence so causal
+    masking stays correct when chunks are distributed.
+
+    Args:
+      state: ``(m, l, acc)`` with shapes ``(B,H,S)``, ``(B,H,S)``,
+        ``(B,H,S,D)`` — f32 running max, normaliser, accumulator.
+      qf: pre-scaled f32 queries ``(B,H,S,D)``.
+      k, v: f32 kv chunk ``(B,H,T,D)``.
+      mask_block: optional bool ``(B,1|H,S,T)``; True keeps.
+    """
+    m, l, acc = state
+    s, t = qf.shape[-2], k.shape[-2]
+    logits = jnp.einsum("bhsd,bhtd->bhst", qf, k)
+    if causal:
+        q_pos = q_offset + lax.broadcasted_iota(jnp.int32, (s, t), 0)
+        k_pos = k_offset + lax.broadcasted_iota(jnp.int32, (s, t), 1)
+        logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+    if mask_block is not None:
+        logits = jnp.where(mask_block, logits, NEG_INF)
+    m_new = jnp.maximum(m, logits.max(axis=-1))
+    p = jnp.exp(logits - m_new[..., None])
+    correction = jnp.exp(m - m_new)
+    l_new = l * correction + p.sum(axis=-1)
+    acc_new = acc * correction[..., None] + jnp.einsum("bhst,bhtd->bhsd", p, v)
+    return m_new, l_new, acc_new
+
+
+def online_softmax_init(b: int, h: int, s: int, d: int):
+    """Zero state for :func:`online_softmax_update`."""
+    return (
+        jnp.full((b, h, s), NEG_INF, jnp.float32),
+        jnp.zeros((b, h, s), jnp.float32),
+        jnp.zeros((b, h, s, d), jnp.float32),
+    )
+
+
+def online_softmax_finish(state, dtype) -> jax.Array:
+    """Normalise the accumulator; fully-masked rows yield 0, not NaN."""
+    m, l, acc = state
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.where((m <= NEG_INF / 2)[..., None], 0.0, out).astype(dtype)
+
+
 def blockwise_attention(
     q: jax.Array,
     k: jax.Array,
@@ -153,37 +212,20 @@ def blockwise_attention(
         mb = mask.reshape(b, mask.shape[1], s, n_blocks, block)
 
     def body(carry, inp):
-        m, l, acc = carry
         (i, kblk, vblk) = inp
         kblk = kblk.transpose(0, 2, 1, 3)  # (B,H,block,D)
         vblk = vblk.transpose(0, 2, 1, 3)
-        logits = jnp.einsum("bhsd,bhtd->bhst", qf, kblk)  # (B,H,S,block)
-        if causal:
-            q_pos = lax.broadcasted_iota(jnp.int32, (s, block), 0)
-            k_pos = i * block + lax.broadcasted_iota(jnp.int32, (s, block), 1)
-            logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+        blk_mask = None
         if mb is not None:
             blk_mask = lax.dynamic_index_in_dim(mb, i, axis=3, keepdims=False)
-            logits = jnp.where(blk_mask, logits, NEG_INF)
-        m_new = jnp.maximum(m, logits.max(axis=-1))
-        p = jnp.exp(logits - m_new[..., None])
-        correction = jnp.exp(m - m_new)
-        l_new = l * correction + p.sum(axis=-1)
-        acc_new = acc * correction[..., None] + jnp.einsum(
-            "bhst,bhtd->bhsd", p, vblk
+        carry = online_softmax_update(
+            carry, qf, kblk, vblk, k_offset=i * block, causal=causal,
+            mask_block=blk_mask,
         )
-        return (m_new, l_new, acc_new), None
+        return carry, None
 
-    m0 = jnp.full((b, h, s), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, s), jnp.float32)
-    acc0 = jnp.zeros((b, h, s, d), jnp.float32)
     ks = jnp.moveaxis(kb, 1, 0)  # (n_blocks, B, block, H, D) for scan
     vs = jnp.moveaxis(vb, 1, 0)
-    (m, l, acc), _ = lax.scan(body, (m0, l0, acc0),
-                              (jnp.arange(n_blocks), ks, vs))
-    # fully-masked rows produce 0 output, not NaN: their running max never
-    # left the NEG_INF floor (p degenerates to exp(0)=1 there, so l>0 and
-    # acc would otherwise average v)
-    out = acc / jnp.maximum(l, 1e-30)[..., None]
-    out = jnp.where((m <= NEG_INF / 2)[..., None], 0.0, out)
-    return out.transpose(0, 2, 1, 3).astype(dtype)
+    state, _ = lax.scan(body, online_softmax_init(b, h, s, d),
+                        (jnp.arange(n_blocks), ks, vs))
+    return online_softmax_finish(state, dtype).transpose(0, 2, 1, 3)

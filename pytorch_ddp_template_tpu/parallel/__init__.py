@@ -1,0 +1,26 @@
+"""Parallelism: sharding rules (dp/tp/sp over the mesh) + ring attention.
+
+The reference's parallel surface is NCCL data parallelism only
+(SURVEY.md §2b); here data parallelism is the ``data`` mesh axis, tensor
+parallelism the ``model`` axis (``sharding.py``), and sequence/context
+parallelism the ``seq`` axis with ring attention (``ring.py``).
+"""
+
+from .ring import ring_attention, ring_attention_local
+from .sharding import (
+    DEFAULT_RULES,
+    active_rules,
+    describe,
+    logical_shardings,
+    shard_tree,
+)
+
+__all__ = [
+    "DEFAULT_RULES",
+    "active_rules",
+    "describe",
+    "logical_shardings",
+    "ring_attention",
+    "ring_attention_local",
+    "shard_tree",
+]

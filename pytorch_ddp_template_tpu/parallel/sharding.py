@@ -1,0 +1,89 @@
+"""Sharding rules: logical model axes → mesh axes.
+
+The reference's only parallelism is data parallelism (SURVEY.md §2b:
+DDP ``/root/reference/ddp.py:194-195``, DataParallel ``ddp.py:189-191``);
+everything else in its inventory table is "No". The TPU framework keeps
+the mesh extensible (SURVEY.md §2b asks for an open model axis), and this
+module is where extensibility becomes mechanism:
+
+- Model code annotates weights with *logical* axis names
+  (``nn.with_logical_partitioning`` in ``models/transformer.py``:
+  ``embed``, ``mlp``, ``heads``, ``kv``, ``vocab``).
+- This module maps logical names onto whatever mesh axes exist. A
+  ``data``-only mesh replicates all weights (pure DDP); adding
+  ``model`` to the mesh spec turns on Megatron-style tensor parallelism
+  — column-split fc1/qkv, row-split fc2/out — with **zero model-code
+  changes**. XLA/GSPMD inserts the all-reduces on the row-split matmuls.
+- ``seq`` shards activation sequence dims (context parallelism; the
+  attention part is ``parallel/ring.py``).
+
+Design note: gradients and SGD optimizer state inherit param shardings
+through XLA propagation (the train step is jitted with sharded params as
+inputs), so no separate optimizer partitioning pass is needed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+from jax.sharding import Mesh
+
+from ..runtime.context import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+
+#: logical axis -> preferred mesh axes, in priority order. A rule applies
+#: only if the mesh has that axis; otherwise the dim is replicated.
+DEFAULT_RULES: tuple[tuple[str, str | None], ...] = (
+    ("batch", DATA_AXIS),
+    ("seq_act", SEQ_AXIS),   # activation sequence dim (context parallel)
+    ("mlp", MODEL_AXIS),     # fc1 column-split
+    ("heads", MODEL_AXIS),   # attention head-split
+    ("vocab", MODEL_AXIS),   # embedding vocab-split
+    ("embed", None),         # row dim of fc1/qkv: replicated (activations
+                             # stay unsharded along embed between blocks)
+    ("kv", None),
+)
+
+
+def active_rules(mesh: Mesh) -> tuple[tuple[str, str | None], ...]:
+    """Drop rules whose mesh axis does not exist (or has size 1)."""
+    sizes = mesh.shape
+    return tuple(
+        (logical, axis if axis in sizes and sizes[axis] > 1 else None)
+        for logical, axis in DEFAULT_RULES
+    )
+
+
+def logical_shardings(tree: Any, mesh: Mesh,
+                      rules: Sequence[tuple[str, str | None]] | None = None):
+    """NamedShardings for a pytree whose leaves may be ``nn.Partitioned``.
+
+    The returned tree matches the *unboxed* structure (each ``Partitioned``
+    box collapses to one sharding leaf). Unannotated leaves (MLP/ResNet
+    weights, scalars, rng keys) map to ``P()`` — fully replicated, the DDP
+    baseline.
+    """
+    rules = tuple(rules if rules is not None else active_rules(mesh))
+    specs = nn.get_partition_spec(tree)
+    return nn.logical_to_mesh_sharding(specs, mesh, rules)
+
+
+def shard_tree(tree: Any, mesh: Mesh,
+               rules: Sequence[tuple[str, str | None]] | None = None):
+    """Unbox + ``device_put`` a pytree onto the mesh per its logical
+    annotations. Returns plain arrays (no ``Partitioned`` wrappers): the
+    logical names have done their job once shardings are on the data."""
+    shardings = logical_shardings(tree, mesh, rules)
+    return jax.device_put(nn.meta.unbox(tree), shardings)
+
+
+def describe(mesh: Mesh) -> dict[str, Any]:
+    """Human-readable sharding summary for the startup log."""
+    sizes = dict(mesh.shape)
+    return {
+        "mesh": sizes,
+        "data_parallel": sizes.get(DATA_AXIS, 1),
+        "tensor_parallel": sizes.get(MODEL_AXIS, 1),
+        "context_parallel": sizes.get(SEQ_AXIS, 1),
+    }

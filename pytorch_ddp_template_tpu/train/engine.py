@@ -29,7 +29,6 @@ import flax.struct
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..checkpoint.manager import CheckpointManager
 from ..config import TrainingConfig
@@ -72,7 +71,6 @@ def make_train_step(
     task: Task,
     tx: optax.GradientTransformation,
     schedule: optax.Schedule,
-    ctx: RuntimeContext,
     accum_steps: int = 1,
 ) -> Callable[[TrainState, dict[str, jax.Array]], tuple[TrainState, dict[str, jax.Array]]]:
     """Build the jitted SPMD train step.
@@ -80,13 +78,16 @@ def make_train_step(
     Batch layout: ``(global_batch, ...)`` sharded over ``data`` when
     ``accum_steps == 1``; ``(accum, micro, ...)`` sharded over ``data`` on
     the micro dim otherwise (see ``ShardedLoader``).
+
+    Sharding contract: shardings live on the *data* — the state arrives
+    sharded from ``Trainer.init_state`` (replicated for pure DDP; weights
+    split over ``model`` under tensor parallelism via
+    ``parallel.sharding``), batches arrive sharded from ``ShardedLoader``
+    (``data`` batch dim, optionally ``seq`` for context parallelism), and
+    jit compiles for whatever it receives. GSPMD then propagates: grads
+    and optimizer updates inherit param shardings, batch reductions emit
+    the cross-replica psum (the NCCL-DDP replacement, SURVEY.md §5.8).
     """
-    mesh = ctx.mesh
-    replicated = NamedSharding(mesh, P())
-    if accum_steps > 1:
-        batch_sharding = NamedSharding(mesh, P(None, "data"))
-    else:
-        batch_sharding = NamedSharding(mesh, P("data"))
 
     def loss_fn(params, extra_vars, batch, rng):
         loss, new_extra, metrics = task.loss(params, extra_vars, batch, rng, train=True)
@@ -145,19 +146,12 @@ def make_train_step(
         out_metrics["lr"] = schedule(state.step)
         return new_state, out_metrics
 
-    return jax.jit(
-        step_fn,
-        in_shardings=(replicated, batch_sharding),
-        out_shardings=(replicated, replicated),
-        donate_argnums=(0,),
-    )
+    return jax.jit(step_fn, donate_argnums=(0,))
 
 
-def make_eval_step(task: Task, ctx: RuntimeContext):
+def make_eval_step(task: Task):
     """Jitted eval step: loss/metrics only, no mutation (the reference's
     ``evaluate`` is a stub, ``ddp.py:123-124`` — this one is real)."""
-    replicated = NamedSharding(ctx.mesh, P())
-    batch_sharding = NamedSharding(ctx.mesh, P("data"))
 
     def step_fn(state: TrainState, batch):
         loss, _, metrics = task.loss(
@@ -167,8 +161,7 @@ def make_eval_step(task: Task, ctx: RuntimeContext):
         out["loss"] = loss
         return out
 
-    return jax.jit(step_fn, in_shardings=(replicated, batch_sharding),
-                   out_shardings=replicated)
+    return jax.jit(step_fn)
 
 
 class Trainer:
@@ -187,6 +180,7 @@ class Trainer:
             config.train_batch_size * config.gradient_accumulation_steps,
             seed=config.seed,
             accum_steps=config.gradient_accumulation_steps,
+            seq_dims=getattr(task, "seq_dims", None),
         )
         # Step accounting (reference: t_total math ddp.py:154-161). One
         # loader batch == one optimizer step, so the reference's
@@ -204,9 +198,9 @@ class Trainer:
 
         self.tx, self.schedule = make_optimizer(config, self.total_steps)
         self.train_step = make_train_step(
-            task, self.tx, self.schedule, ctx, config.gradient_accumulation_steps
+            task, self.tx, self.schedule, config.gradient_accumulation_steps
         )
-        self.eval_step = make_eval_step(task, ctx)
+        self.eval_step = make_eval_step(task)
         self.ckpt = CheckpointManager(config.output_dir)
         self.metrics_writer = MetricsWriter(config.output_dir)
 
@@ -226,10 +220,13 @@ class Trainer:
             # context's own key buffer would delete it for later use
             rng=jax.random.clone(self.ctx.seed_key),
         )
-        # Replicate explicitly onto the mesh: the DDP-construction param
-        # broadcast (ddp.py:194-195) expressed as a sharding constraint.
-        replicated = NamedSharding(self.ctx.mesh, P())
-        return jax.device_put(state, replicated)
+        # Place the state onto the mesh per its logical annotations: the
+        # DDP-construction param broadcast (ddp.py:194-195) as a sharding —
+        # replicated for plain-DDP models, split over ``model`` for
+        # tensor-parallel meshes (parallel/sharding.py rules).
+        from ..parallel.sharding import shard_tree
+
+        return shard_tree(state, self.ctx.mesh)
 
     def restore_or_init(self) -> tuple[TrainState, int]:
         state = self.init_state()
@@ -253,6 +250,7 @@ class Trainer:
         loader = ShardedLoader(
             self.eval_dataset, self.ctx.mesh, self.config.train_batch_size,
             seed=0, shuffle=False,
+            seq_dims=getattr(self.task, "seq_dims", None),
         )
         totals: dict[str, Any] = {}
         n = 0

@@ -229,7 +229,7 @@ class TestReviewRegressions:
         ctx = rt_init(cfg)
         task = RngProbeTask()
         tx, sched = make_optimizer(cfg, 10)
-        step = make_train_step(task, tx, sched, ctx, accum_steps=2)
+        step = make_train_step(task, tx, sched, accum_steps=2)
 
         batch = {"x": jax.device_put(jnp.ones((2, 16, 4)),
                                      NamedSharding(ctx.mesh, P(None, "data")))}

@@ -97,7 +97,7 @@ def test_loss_goes_down_through_engine(name, tmp_path):
     state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
                        extra_vars=extra, opt_state=tx.init(params),
                        rng=jax.random.clone(key))
-    step = make_train_step(task, tx, schedule, ctx)
+    step = make_train_step(task, tx, schedule)
     losses = []
     for _ in range(8):
         state, metrics = step(state, batch)  # same batch: must overfit
