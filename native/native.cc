@@ -1,0 +1,159 @@
+// Host-side native runtime: the C++ layer of the TPU framework.
+//
+// The reference's native surface is third-party CUDA/C++ it links against
+// (NCCL collectives, CUDA runtime, apex — SURVEY.md 2c); its first-party
+// code is pure Python. On TPU the collective/compute roles belong to
+// XLA/Pallas, so the native layer lives where TPU training actually
+// bottlenecks on the host: the input pipeline (SURVEY.md 7 hard part (e)).
+//
+// Exports (C ABI, bound via ctypes in pytorch_ddp_template_tpu/native.py):
+//   ddp_permutation  - seeded Fisher-Yates epoch permutation (the
+//                      DistributedSampler reshuffle, ddp.py:213-214, as a
+//                      native kernel; counter-based seeding = set_epoch)
+//   ddp_synth_u8     - threaded per-sample synthetic byte generation
+//                      (ImageNet-shaped sample fabrication at memory
+//                      bandwidth instead of a Python per-sample loop)
+//   ddp_gather_rows  - threaded strided row gather (host-side batch
+//                      assembly: dataset rows -> contiguous batch slab)
+//
+// Determinism: splitmix64 seeding + xoshiro256** streams, keyed by
+// (seed, epoch) or (seed, sample_index) counters only - never by call
+// order - so every host computes identical data independently, which is
+// what makes the per-host disjoint loading scheme coherent without any
+// cross-host communication.
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint64_t kGolden = 0x9e3779b97f4a7c15ULL;
+
+inline uint64_t splitmix64(uint64_t& state) {
+  uint64_t z = (state += kGolden);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  return z ^ (z >> 31);
+}
+
+struct Xoshiro256 {
+  uint64_t s[4];
+
+  explicit Xoshiro256(uint64_t seed) {
+    uint64_t sm = seed;
+    for (auto& w : s) w = splitmix64(sm);
+  }
+
+  static inline uint64_t rotl(uint64_t x, int k) {
+    return (x << k) | (x >> (64 - k));
+  }
+
+  inline uint64_t next() {
+    const uint64_t result = rotl(s[1] * 5, 7) * 9;
+    const uint64_t t = s[1] << 17;
+    s[2] ^= s[0];
+    s[3] ^= s[1];
+    s[1] ^= s[2];
+    s[0] ^= s[3];
+    s[2] ^= t;
+    s[3] = rotl(s[3], 45);
+    return result;
+  }
+
+  // uniform integer in [0, bound) without modulo bias (Lemire)
+  inline uint64_t bounded(uint64_t bound) {
+    while (true) {
+      uint64_t x = next();
+      __uint128_t m = static_cast<__uint128_t>(x) * bound;
+      uint64_t l = static_cast<uint64_t>(m);
+      if (l >= bound || l >= (-bound) % bound) return m >> 64;
+    }
+  }
+};
+
+inline uint64_t mix2(uint64_t a, uint64_t b) {
+  uint64_t st = a * kGolden + b;
+  return splitmix64(st);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Fisher-Yates permutation of [0, n) keyed on (seed, epoch).
+// out must hold n int64 values.
+void ddp_permutation(uint64_t seed, uint64_t epoch, int64_t n, int64_t* out) {
+  for (int64_t i = 0; i < n; ++i) out[i] = i;
+  Xoshiro256 rng(mix2(seed, epoch));
+  for (int64_t i = n - 1; i > 0; --i) {
+    int64_t j = static_cast<int64_t>(rng.bounded(static_cast<uint64_t>(i) + 1));
+    int64_t tmp = out[i];
+    out[i] = out[j];
+    out[j] = tmp;
+  }
+}
+
+// Fill out[k * bytes_per_sample ...] with the deterministic byte stream of
+// sample indices[k], stream keyed on (seed, index). Threaded over samples.
+void ddp_synth_u8(uint64_t seed, const int64_t* indices, int64_t n_samples,
+                  int64_t bytes_per_sample, uint8_t* out, int32_t n_threads) {
+  if (n_threads < 1) n_threads = 1;
+  std::atomic<int64_t> cursor{0};
+  auto worker = [&]() {
+    while (true) {
+      int64_t k = cursor.fetch_add(1);
+      if (k >= n_samples) return;
+      Xoshiro256 rng(mix2(seed, static_cast<uint64_t>(indices[k])));
+      uint8_t* dst = out + k * bytes_per_sample;
+      int64_t full = bytes_per_sample / 8;
+      for (int64_t w = 0; w < full; ++w) {
+        uint64_t x = rng.next();
+        std::memcpy(dst + w * 8, &x, 8);
+      }
+      int64_t rem = bytes_per_sample - full * 8;
+      if (rem) {
+        uint64_t x = rng.next();
+        std::memcpy(dst + full * 8, &x, rem);
+      }
+    }
+  };
+  if (n_threads == 1) {
+    worker();
+    return;
+  }
+  std::vector<std::thread> pool;
+  pool.reserve(n_threads);
+  for (int t = 0; t < n_threads; ++t) pool.emplace_back(worker);
+  for (auto& th : pool) th.join();
+}
+
+// Gather rows: out[k] = src[indices[k]] for row_bytes-sized rows.
+// The host-side batch assembly (DataLoader collate equivalent) as one
+// threaded memcpy sweep.
+void ddp_gather_rows(const uint8_t* src, const int64_t* indices,
+                     int64_t n_rows, int64_t row_bytes, uint8_t* out,
+                     int32_t n_threads) {
+  if (n_threads < 1) n_threads = 1;
+  std::atomic<int64_t> cursor{0};
+  auto worker = [&]() {
+    while (true) {
+      int64_t k = cursor.fetch_add(1);
+      if (k >= n_rows) return;
+      std::memcpy(out + k * row_bytes, src + indices[k] * row_bytes,
+                  row_bytes);
+    }
+  };
+  if (n_threads == 1) {
+    worker();
+    return;
+  }
+  std::vector<std::thread> pool;
+  pool.reserve(n_threads);
+  for (int t = 0; t < n_threads; ++t) pool.emplace_back(worker);
+  for (auto& th : pool) th.join();
+}
+
+}  // extern "C"

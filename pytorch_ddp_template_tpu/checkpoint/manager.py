@@ -47,11 +47,18 @@ class CheckpointManager:
     # -- save -------------------------------------------------------------
     def save(self, step: int, state: Any, config: TrainingConfig,
              *, force: bool = False) -> None:
+        from .. import native
+
+        payload = dataclasses.asdict(config)
+        # provenance: which RNG stream produced the data order (native C++
+        # vs numpy fallback) — resume must replay the same stream for the
+        # mid-epoch data-order restore to be exact
+        payload["_native_rng"] = native.available()
         self._mngr.save(
             step,
             args=ocp.args.Composite(
                 state=ocp.args.StandardSave(state),
-                config=ocp.args.JsonSave(dataclasses.asdict(config)),
+                config=ocp.args.JsonSave(payload),
             ),
             force=force,
         )
@@ -85,8 +92,19 @@ class CheckpointManager:
                 config=ocp.args.JsonRestore(),
             ),
         )
+        cfg = restored["config"]
+        from .. import native
+
+        saved_native = cfg.get("_native_rng") if isinstance(cfg, dict) else None
+        if saved_native is not None and saved_native != native.available():
+            log.warning(
+                "checkpoint was written with a different RNG stream "
+                "(native=%s, now=%s); resumed data order will not exactly "
+                "replay the interrupted epoch",
+                saved_native, native.available(),
+            )
         log.info("checkpoint restored", {"step": step})
-        return restored["state"], restored["config"]
+        return restored["state"], cfg
 
     def close(self) -> None:
         self._mngr.close()

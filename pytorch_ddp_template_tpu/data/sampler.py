@@ -43,8 +43,14 @@ def shard_indices(
         raise ValueError("empty dataset")
 
     if shuffle:
-        rng = np.random.default_rng(np.random.SeedSequence([seed, epoch]))
-        indices = rng.permutation(length)
+        from .. import native
+
+        if native.available():
+            # C++ Fisher-Yates keyed on (seed, epoch) — native.cc
+            indices = native.permutation(seed, epoch, length)
+        else:
+            rng = np.random.default_rng(np.random.SeedSequence([seed, epoch]))
+            indices = rng.permutation(length)
     else:
         indices = np.arange(length)
 

@@ -1,0 +1,119 @@
+"""ctypes binding to the native host runtime (``native/native.cc``).
+
+The reference's native capability arrives through third-party CUDA
+libraries (NCCL/apex, SURVEY.md §2c); this framework's first-party native
+layer targets the host input path instead — the classic TPU bottleneck
+(SURVEY.md §7 hard part (e)): epoch permutation, synthetic sample
+fabrication, and batch row gather, all C++ with counter-based RNG.
+
+Graceful degradation: if ``libddptpu_native.so`` is absent (not built) or
+``DDPTPU_NATIVE=0``, callers fall back to their numpy paths. The native
+RNG streams are *defined* by (seed, counter) keys, so data is reproducible
+across runs and hosts on the same path; the numpy fallback is a separate
+deterministic stream (documented in data/dataset.py).
+
+Build: ``make -C native`` (plain g++, no deps).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from pathlib import Path
+
+import numpy as np
+
+_LIB_NAME = "libddptpu_native.so"
+
+
+def _find_library() -> ctypes.CDLL | None:
+    if os.environ.get("DDPTPU_NATIVE", "1") == "0":
+        return None
+    candidates = [
+        Path(os.environ.get("DDPTPU_NATIVE_LIB", "")),
+        Path(__file__).resolve().parent.parent / "native" / _LIB_NAME,
+    ]
+    for path in candidates:
+        if path and path.is_file():
+            try:
+                return ctypes.CDLL(str(path))
+            except OSError:
+                continue
+    return None
+
+
+_lib = _find_library()
+
+if _lib is not None:
+    _lib.ddp_permutation.argtypes = [
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+    ]
+    _lib.ddp_synth_u8.argtypes = [
+        ctypes.c_uint64,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ctypes.c_int64, ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+        ctypes.c_int32,
+    ]
+    _lib.ddp_gather_rows.argtypes = [
+        np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ctypes.c_int64, ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+        ctypes.c_int32,
+    ]
+
+
+def available() -> bool:
+    return _lib is not None
+
+
+def default_threads() -> int:
+    return max(1, min(8, os.cpu_count() or 1))
+
+
+def permutation(seed: int, epoch: int, n: int) -> np.ndarray:
+    """Fisher-Yates permutation of [0, n) keyed on (seed, epoch)."""
+    if _lib is None:
+        raise RuntimeError("native library not available")
+    out = np.empty(n, np.int64)
+    _lib.ddp_permutation(seed, epoch, n, out)
+    return out
+
+
+def synth_u8(seed: int, indices: np.ndarray, bytes_per_sample: int,
+             n_threads: int | None = None) -> np.ndarray:
+    """Deterministic per-sample byte streams keyed on (seed, index);
+    returns ``(len(indices), bytes_per_sample)`` uint8."""
+    if _lib is None:
+        raise RuntimeError("native library not available")
+    idx = np.ascontiguousarray(indices, np.int64)
+    out = np.empty((len(idx), bytes_per_sample), np.uint8)
+    _lib.ddp_synth_u8(seed, idx, len(idx), bytes_per_sample, out,
+                      n_threads or default_threads())
+    return out
+
+
+def gather_rows(src: np.ndarray, indices: np.ndarray,
+                n_threads: int | None = None) -> np.ndarray:
+    """``src[indices]`` for a 2D+ C-contiguous array via threaded memcpy."""
+    if _lib is None:
+        raise RuntimeError("native library not available")
+    src = np.ascontiguousarray(src)
+    idx = np.ascontiguousarray(indices, np.int64)
+    idx = np.where(idx < 0, idx + len(src), idx)  # numpy negative-index semantics
+    if len(idx) and (idx.min() < 0 or idx.max() >= len(src)):
+        raise IndexError(
+            f"gather index out of range [0, {len(src)}): "
+            f"min={idx.min()}, max={idx.max()}"
+        )
+    row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], initial=1))
+    out = np.empty((len(idx), *src.shape[1:]), src.dtype)
+    _lib.ddp_gather_rows(
+        src.view(np.uint8).reshape(len(src), row_bytes),
+        idx, len(idx), row_bytes,
+        out.view(np.uint8).reshape(len(idx), row_bytes),
+        n_threads or default_threads(),
+    )
+    return out

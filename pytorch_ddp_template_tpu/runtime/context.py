@@ -138,6 +138,23 @@ def init(config: TrainingConfig) -> RuntimeContext:
         atexit.register(shutdown)
 
     devices = jax.devices()
+    if jax.process_count() > 1:
+        # RNG-path agreement: data order / synthetic streams come from the
+        # native C++ RNG when libddptpu_native.so is present, else numpy.
+        # A mixed fleet would silently break the disjoint-cover sharding
+        # invariant (each stream is deterministic, but they differ).
+        from .. import native
+        from jax.experimental import multihost_utils
+
+        flags = np.asarray(multihost_utils.process_allgather(
+            np.asarray([1 if native.available() else 0], np.int32)
+        )).reshape(-1)
+        if len(set(flags.tolist())) > 1:
+            raise RuntimeError(
+                "native host runtime availability differs across processes "
+                f"({flags.tolist()}); build native/ on every host or set "
+                "DDPTPU_NATIVE=0 everywhere"
+            )
     mesh = make_mesh(config.mesh, devices)
     seed_key = jax.random.PRNGKey(config.seed)
     host_key = jax.random.fold_in(seed_key, jax.process_index())

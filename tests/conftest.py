@@ -22,6 +22,16 @@ except Exception:  # noqa: BLE001 - older jax: XLA_FLAGS alone applies
 
 import pytest  # noqa: E402
 
+# Build the native host runtime (plain g++; ~1s). Tests that need it
+# skip with a reason if the build fails.
+_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+import subprocess  # noqa: E402
+
+# unconditional: make no-ops when the .so is newer than native.cc, and
+# rebuilds after source edits (a stale-binary guard, not just a bootstrap)
+subprocess.run(["make", "-C", os.path.join(_root, "native")],
+               capture_output=True, check=False)
+
 
 @pytest.fixture(scope="session")
 def devices():

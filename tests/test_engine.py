@@ -69,15 +69,17 @@ class TestStepAccounting:
 
 class TestTrainStep:
     def test_loss_goes_down(self, tmp_path):
-        t = make_trainer(tmp_path, max_steps=30, learning_rate=5e-2)
+        # windowed means over two epochs of the SAME data: single-batch
+        # comparisons on random regression targets are order-noise
+        t = make_trainer(tmp_path, max_steps=32, learning_rate=5e-2)
         state, _ = t.restore_or_init()
-        first = None
-        for batch in t.loader.epoch(0):
-            state, metrics = t.train_step(state, batch)
-            if first is None:
-                first = float(metrics["loss"])
-        last = float(metrics["loss"])
-        assert last < first  # MLP fits random data enough to descend
+        losses = []
+        for epoch in range(2):
+            for batch in t.loader.epoch(epoch):
+                state, metrics = t.train_step(state, batch)
+                losses.append(float(metrics["loss"]))
+        k = len(losses) // 4
+        assert sum(losses[-k:]) / k < sum(losses[:k]) / k, losses
 
     def test_sharded_grads_equal_single_device(self, tmp_path):
         """The DDP-semantics test: psum'd sharded grads == grads on the

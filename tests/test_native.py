@@ -1,0 +1,132 @@
+"""Native host runtime (native/native.cc via ctypes): correctness against
+an independent pure-Python implementation of the same splitmix64 /
+xoshiro256** streams, plus integration with the data layer.
+
+Skips (with a visible reason) if the library isn't built —
+``make -C native`` is the one-command build."""
+
+import numpy as np
+import pytest
+
+from pytorch_ddp_template_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="libddptpu_native.so not built (make -C native)"
+)
+
+MASK = (1 << 64) - 1
+GOLDEN = 0x9E3779B97F4A7C15
+
+
+def _splitmix64(state):
+    state = (state + GOLDEN) & MASK
+    z = state
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & MASK
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & MASK
+    return state, (z ^ (z >> 31)) & MASK
+
+
+def _mix2(a, b):
+    st = (a * GOLDEN + b) & MASK
+    _, out = _splitmix64(st)
+    return out
+
+
+class _Xoshiro:
+    def __init__(self, seed):
+        self.s = []
+        st = seed
+        for _ in range(4):
+            st, w = _splitmix64(st)
+            self.s.append(w)
+
+    @staticmethod
+    def _rotl(x, k):
+        return ((x << k) | (x >> (64 - k))) & MASK
+
+    def next(self):
+        s = self.s
+        result = (self._rotl((s[1] * 5) & MASK, 7) * 9) & MASK
+        t = (s[1] << 17) & MASK
+        s[2] ^= s[0]
+        s[3] ^= s[1]
+        s[1] ^= s[2]
+        s[0] ^= s[3]
+        s[2] ^= t
+        s[3] = self._rotl(s[3], 45)
+        return result
+
+    def bounded(self, bound):
+        while True:
+            x = self.next()
+            m = x * bound
+            low = m & MASK
+            if low >= bound or low >= (-bound) % (1 << 64) % bound:
+                return m >> 64
+
+
+def _ref_permutation(seed, epoch, n):
+    out = list(range(n))
+    rng = _Xoshiro(_mix2(seed, epoch))
+    for i in range(n - 1, 0, -1):
+        j = rng.bounded(i + 1)
+        out[i], out[j] = out[j], out[i]
+    return np.asarray(out)
+
+
+def _ref_synth(seed, index, nbytes):
+    rng = _Xoshiro(_mix2(seed, index))
+    out = b""
+    while len(out) < nbytes:
+        out += int(rng.next()).to_bytes(8, "little")
+    return np.frombuffer(out[:nbytes], np.uint8)
+
+
+def test_permutation_matches_python_reference():
+    got = native.permutation(42, 3, 257)
+    want = _ref_permutation(42, 3, 257)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_permutation_is_valid_and_epoch_dependent():
+    p0 = native.permutation(7, 0, 10_000)
+    p1 = native.permutation(7, 1, 10_000)
+    assert sorted(p0) == list(range(10_000))
+    assert not np.array_equal(p0, p1)
+    np.testing.assert_array_equal(p0, native.permutation(7, 0, 10_000))
+
+
+def test_synth_matches_python_reference():
+    idx = np.array([0, 5, 123456], np.int64)
+    got = native.synth_u8(9, idx, 75)  # odd size exercises the tail word
+    for row, i in zip(got, idx):
+        np.testing.assert_array_equal(row, _ref_synth(9, int(i), 75))
+
+
+def test_synth_threaded_matches_single_thread():
+    idx = np.arange(64, dtype=np.int64)
+    a = native.synth_u8(1, idx, 1024, n_threads=1)
+    b = native.synth_u8(1, idx, 1024, n_threads=8)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_gather_rows_matches_numpy():
+    rng = np.random.default_rng(0)
+    src = rng.standard_normal((100, 17)).astype(np.float32)
+    idx = rng.integers(0, 100, 40)
+    np.testing.assert_array_equal(native.gather_rows(src, idx), src[idx])
+    src3 = rng.integers(0, 255, (50, 4, 6), dtype=np.uint8)
+    np.testing.assert_array_equal(native.gather_rows(src3, idx % 50), src3[idx % 50])
+
+
+def test_image_dataset_uses_native_and_is_deterministic():
+    from pytorch_ddp_template_tpu.data.dataset import SyntheticImageDataset
+
+    ds = SyntheticImageDataset(samples=32, image_size=8, num_classes=4, seed=3)
+    b1 = ds.batch(np.array([0, 7, 31]))
+    b2 = ds.batch(np.array([0, 7, 31]))
+    np.testing.assert_array_equal(b1["image"], b2["image"])
+    assert b1["image"].shape == (3, 8, 8, 3)
+    # different seed -> different pixels
+    ds2 = SyntheticImageDataset(samples=32, image_size=8, num_classes=4, seed=4)
+    assert not np.array_equal(b1["image"], ds2.batch(np.array([0, 7, 31]))["image"])
