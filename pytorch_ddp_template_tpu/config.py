@@ -60,6 +60,8 @@ class TrainingConfig:
     dataset_size: int = 100_000  # reference: FooDataset(100000) at ddp.py:135
     eval_steps: int = 0  # 0 disables; reference evaluate() is a stub (ddp.py:123-124)
     resume: bool = True  # auto-resume from latest checkpoint in output_dir
+    profile_steps: int = 0  # trace steps [10, 10+N) to output_dir/profile (SURVEY.md §5.1)
+    divergence_check_steps: int = 0  # cross-host param fingerprint every N steps (§5.2)
 
     @property
     def train_batch_size(self) -> int:
@@ -123,6 +125,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--dataset_size", type=int, default=100_000)
     p.add_argument("--eval_steps", type=int, default=0)
     p.add_argument("--no_resume", dest="resume", action="store_false")
+    p.add_argument("--profile_steps", type=int, default=0,
+                   help="Capture a profiler trace over N steps (from step 10).")
+    p.add_argument("--divergence_check_steps", type=int, default=0,
+                   help="Cross-host replicated-state fingerprint check every N steps.")
     return p
 
 

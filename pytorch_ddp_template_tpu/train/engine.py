@@ -36,6 +36,8 @@ from ..data.loader import ShardedLoader
 from ..models.task import Task
 from ..runtime.context import RuntimeContext
 from ..utils import get_logger, is_main_process
+from ..utils.divergence import check as divergence_check
+from ..utils.profiler import StepTimer, TraceWindow
 from .metrics import MetricsWriter
 from .schedule import linear_schedule_with_warmup
 
@@ -288,6 +290,9 @@ class Trainer:
 
         global_step = start_step
         window: list[jax.Array] = []
+        trace = TraceWindow(cfg.output_dir, start_step=start_step + 10,
+                            num_steps=cfg.profile_steps)
+        timer = StepTimer()
         t_last = time.perf_counter()
         examples_per_step = cfg.train_batch_size * cfg.gradient_accumulation_steps
         start_epoch = start_step // self.steps_per_epoch
@@ -299,7 +304,9 @@ class Trainer:
             # an uninterrupted run
             skip = start_step % self.steps_per_epoch if epoch == start_epoch else 0
             for batch in self.loader.epoch(epoch, start_batch=skip):
+                trace.step(global_step)
                 state, metrics = self.train_step(state, batch)
+                timer.tick()
                 global_step += 1
                 if cfg.logging_steps:  # window only consumed when logging
                     window.append(metrics["loss"])
@@ -318,6 +325,7 @@ class Trainer:
                         "grad_norm": float(metrics["grad_norm"]),
                         "steps_per_sec": steps_per_s,
                         "examples_per_sec": steps_per_s * examples_per_step,
+                        **timer.summary(),
                     }
                     self.metrics_writer.write(global_step, scalars)
                     if pbar is not None:
@@ -330,6 +338,12 @@ class Trainer:
                         self.metrics_writer.write(global_step, ev)
                         log.info("eval", {"step": global_step, **ev})
 
+                if (cfg.divergence_check_steps
+                        and global_step % cfg.divergence_check_steps == 0):
+                    # SPMD desync detector (utils/divergence.py): replicated
+                    # state must fingerprint identically on every host
+                    divergence_check(state.params, step=global_step)
+
                 if cfg.save_steps and global_step % cfg.save_steps == 0:
                     self.ckpt.save(global_step, state, cfg)
 
@@ -341,6 +355,7 @@ class Trainer:
 
         if pbar is not None:
             pbar.close()
+        trace.close()
         if self.ckpt.latest_step() != global_step:  # avoid duplicate final save
             self.ckpt.save(global_step, state, cfg, force=True)
         self.ckpt.wait()

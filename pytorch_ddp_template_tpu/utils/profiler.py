@@ -1,0 +1,93 @@
+"""Profiling: trace capture + step timing statistics.
+
+The reference has no tracing/profiling at all (SURVEY.md §5.1: only tqdm
+rates and TB scalars); for a TPU framework the profiler is table stakes —
+the ≥90% scaling target (BASELINE.md) is won by reading overlap out of
+traces, not by guessing.
+
+Two tools:
+
+- :class:`TraceWindow` — captures a ``jax.profiler`` trace for steps
+  ``[start, start+steps)`` into ``<output_dir>/profile``; view with
+  TensorBoard's profile plugin or Perfetto. Wired to ``--profile_steps``.
+- :class:`StepTimer` — cheap wall-clock accounting of every step with
+  p50/p90/p99 summaries; catches input-bound stalls (step time >> device
+  time) without a trace.
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+
+import jax
+import numpy as np
+
+from .logging import get_logger
+
+log = get_logger(__name__)
+
+
+class TraceWindow:
+    """Capture a profiler trace over a step window (host 0 only).
+
+    Usage: call :meth:`step` once per training step; the window
+    [start_step, start_step + num_steps) is traced.
+    """
+
+    def __init__(self, output_dir: str | Path, start_step: int = 10,
+                 num_steps: int = 0, enabled: bool = True):
+        self.dir = str(Path(output_dir) / "profile")
+        self.start = start_step
+        self.stop_at = start_step + num_steps
+        self.enabled = enabled and num_steps > 0 and jax.process_index() == 0
+        self._active = False
+
+    def step(self, step: int) -> None:
+        if not self.enabled:
+            return
+        if not self._active and step >= self.start and step < self.stop_at:
+            jax.profiler.start_trace(self.dir)
+            self._active = True
+            log.info("profiler trace started", {"step": step, "dir": self.dir})
+        elif self._active and step >= self.stop_at:
+            jax.profiler.stop_trace()
+            self._active = False
+            log.info("profiler trace written", {"step": step, "dir": self.dir})
+
+    def close(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+
+
+class StepTimer:
+    """Rolling wall-clock step timer with percentile summaries."""
+
+    def __init__(self, capacity: int = 2048):
+        self._times: list[float] = []
+        self._capacity = capacity
+        self._last: float | None = None
+
+    def tick(self) -> float | None:
+        """Mark a step boundary; returns the last step's duration."""
+        now = time.perf_counter()
+        dt = None
+        if self._last is not None:
+            dt = now - self._last
+            if len(self._times) >= self._capacity:
+                self._times.pop(0)
+            self._times.append(dt)
+        self._last = now
+        return dt
+
+    def summary(self) -> dict[str, float]:
+        if not self._times:
+            return {}
+        arr = np.asarray(self._times)
+        return {
+            "step_time_p50_ms": float(np.percentile(arr, 50) * 1e3),
+            "step_time_p90_ms": float(np.percentile(arr, 90) * 1e3),
+            "step_time_p99_ms": float(np.percentile(arr, 99) * 1e3),
+            "step_time_mean_ms": float(arr.mean() * 1e3),
+        }

@@ -1,0 +1,66 @@
+"""Profiler + divergence subsystems (SURVEY.md §5.1/§5.2 — absent in the
+reference, first-class here)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_ddp_template_tpu.utils.divergence import check, fingerprint
+from pytorch_ddp_template_tpu.utils.profiler import StepTimer, TraceWindow
+
+
+def test_fingerprint_detects_any_leaf_change():
+    tree = {"a": jnp.arange(8.0), "b": {"w": jnp.ones((3, 3))}}
+    fp = np.asarray(fingerprint(tree))
+    tree2 = {"a": jnp.arange(8.0).at[3].add(1e-3), "b": {"w": jnp.ones((3, 3))}}
+    fp2 = np.asarray(fingerprint(tree2))
+    assert not np.array_equal(fp, fp2)
+    np.testing.assert_array_equal(fp, np.asarray(fingerprint(tree)))
+
+
+def test_check_single_process_true():
+    assert check({"w": jnp.ones(4)}) is True
+
+
+def test_step_timer_summary():
+    t = StepTimer()
+    assert t.summary() == {}
+    for _ in range(5):
+        t.tick()
+    s = t.summary()
+    assert set(s) == {"step_time_p50_ms", "step_time_p90_ms",
+                      "step_time_p99_ms", "step_time_mean_ms"}
+    assert all(v >= 0 for v in s.values())
+
+
+def test_trace_window_writes_profile(tmp_path):
+    tw = TraceWindow(tmp_path, start_step=1, num_steps=2)
+    for step in range(5):
+        tw.step(step)
+        jnp.sum(jnp.arange(16.0)).block_until_ready()
+    tw.close()
+    profile_dir = tmp_path / "profile"
+    assert profile_dir.exists()
+    assert any(profile_dir.rglob("*.xplane.pb")), list(profile_dir.rglob("*"))
+
+
+def test_trainer_with_profiling_and_divergence(tmp_path):
+    from pytorch_ddp_template_tpu.config import TrainingConfig
+    from pytorch_ddp_template_tpu.models import build
+    from pytorch_ddp_template_tpu.runtime import make_mesh
+    from pytorch_ddp_template_tpu.runtime.context import RuntimeContext
+    from pytorch_ddp_template_tpu.train.engine import Trainer
+
+    cfg = TrainingConfig(
+        model="mlp", dataset_size=256, per_device_train_batch_size=2,
+        max_steps=14, logging_steps=5, save_steps=0, output_dir=str(tmp_path),
+        profile_steps=2, divergence_check_steps=5, resume=False,
+    )
+    mesh = make_mesh("data:-1", jax.devices())
+    key = jax.random.PRNGKey(0)
+    ctx = RuntimeContext(mesh=mesh, seed_key=key,
+                         host_key=jax.random.fold_in(key, 0), config=cfg)
+    task, ds = build("mlp", cfg)
+    state = Trainer(cfg, ctx, task, ds).train()
+    assert int(state.step) == 14
+    assert (tmp_path / "profile").exists()

