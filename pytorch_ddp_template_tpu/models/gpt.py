@@ -1,0 +1,126 @@
+"""GPT-family decoder-only causal LM.
+
+No counterpart in the reference (zoo = one MLP,
+``/root/reference/model.py:8-16``); this family completes the long-context
+story for the autoregressive case: the causal paths of the Pallas flash
+kernel (block-skipped lower triangle, ``ops/flash.py``) and of ring
+attention (offset-correct distributed causal masking,
+``parallel/ring.py``) run inside a real model here. TPU-first choices
+match the rest of the zoo: pre-LN blocks, bf16 compute with f32 norms,
+tied embedding/LM head (one MXU transpose matmul), remat for long
+configs.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .task import Task
+from .transformer import TransformerEncoder, default_kernel_init
+
+
+class GptDecoder(nn.Module):
+    """Decoder-only transformer LM; returns next-token logits (B, T, V)."""
+
+    vocab_size: int = 50_257
+    max_len: int = 1024
+    num_layers: int = 12
+    num_heads: int = 12
+    head_dim: int = 64
+    mlp_dim: int = 3072
+    dtype: jnp.dtype = jnp.float32
+    dropout_rate: float = 0.0
+    attn_impl: str = "auto"  # Impl | "ring" (context parallelism)
+    mesh: jax.sharding.Mesh | None = None
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, input_ids, *, train: bool = True):
+        embed_dim = self.num_heads * self.head_dim
+        embed = nn.Embed(
+            self.vocab_size,
+            embed_dim,
+            dtype=self.dtype,
+            embedding_init=nn.with_logical_partitioning(
+                default_kernel_init, ("vocab", "embed")
+            ),
+            name="wte",
+        )
+        pos = nn.Embed(self.max_len, embed_dim, dtype=self.dtype,
+                       embedding_init=default_kernel_init, name="wpe")
+        x = embed(input_ids) + pos(jnp.arange(input_ids.shape[1]))[None]
+        if self.dropout_rate:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = TransformerEncoder(
+            num_layers=self.num_layers,
+            num_heads=self.num_heads,
+            head_dim=self.head_dim,
+            mlp_dim=self.mlp_dim,
+            dtype=self.dtype,
+            dropout_rate=self.dropout_rate,
+            pre_norm=True,  # GPT-2 style
+            attn_impl=self.attn_impl,
+            mesh=self.mesh,
+            causal=True,
+            remat=self.remat,
+            name="decoder",
+        )(x, train=train)
+        x = nn.LayerNorm(dtype=jnp.float32, name="final_ln")(x)
+        logits = embed.attend(x.astype(self.dtype))  # tied head
+        return logits.astype(jnp.float32)
+
+
+class CausalLmTask(Task):
+    """Next-token cross-entropy over ``batch = {"input_ids": (B, T)}``."""
+
+    seq_dims = {"input_ids": 1}
+
+    def model_inputs(self, batch):
+        return (batch["input_ids"],)
+
+    def loss(self, params, extra_vars, batch, rng, *, train=True):
+        input_ids = batch["input_ids"]
+        variables = {"params": params, **extra_vars}
+        kwargs = {"train": train}
+        if train and rng is not None:
+            kwargs["rngs"] = {"dropout": rng}
+        logits = self.model.apply(variables, input_ids, **kwargs)
+
+        # predict token t+1 from prefix ..t; last position has no target
+        logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+        targets = input_ids[:, 1:].astype(jnp.int32)
+        token_logp = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        loss = -jnp.mean(token_logp)
+        acc = jnp.mean(
+            (jnp.argmax(logits[:, :-1], -1) == targets).astype(jnp.float32)
+        )
+        return loss, extra_vars, {"loss": loss, "next_token_accuracy": acc}
+
+
+def gpt_small(dtype=jnp.float32, attn_impl: str = "auto", remat: bool = False,
+              seq_len: int = 1024, vocab_size: int = 50_257,
+              mesh=None) -> GptDecoder:
+    """GPT-2-small shape: 12 layers, 12 heads, 768 wide (~124M params)."""
+    return GptDecoder(vocab_size=vocab_size, max_len=seq_len, dtype=dtype,
+                      attn_impl=attn_impl, mesh=mesh, remat=remat)
+
+
+def gpt_long(seq_len: int = 4096, dtype=jnp.float32, mesh=None,
+             vocab_size: int = 50_257, **size_overrides) -> GptDecoder:
+    """Long-context GPT: causal ring attention over the ``seq`` mesh axis
+    when present, blockwise attention otherwise; remat per block."""
+    ring = bool(mesh) and mesh.shape.get("seq", 1) > 1
+    return GptDecoder(vocab_size=vocab_size, max_len=seq_len, dtype=dtype,
+                      attn_impl="ring" if ring else "blockwise",
+                      mesh=mesh if ring else None, remat=True,
+                      **size_overrides)
+
+
+def gpt_tiny(dtype=jnp.float32, attn_impl: str = "auto", seq_len: int = 128,
+             vocab_size: int = 1024) -> GptDecoder:
+    """Test-sized GPT: 2 layers, 2 heads — CPU-CI fast."""
+    return GptDecoder(vocab_size=vocab_size, max_len=seq_len, num_layers=2,
+                      num_heads=2, head_dim=32, mlp_dim=128, dtype=dtype,
+                      attn_impl=attn_impl)

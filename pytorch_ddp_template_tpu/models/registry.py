@@ -193,3 +193,67 @@ def _bert_long_tiny(config: TrainingConfig, mesh=None):
     ds = SyntheticTokenDataset(samples=config.dataset_size, seq_len=seq_len,
                                vocab=vocab, seed=config.seed)
     return task, ds
+
+
+def _token_entry(config: TrainingConfig, task, seq_len: int, vocab: int):
+    from ..data.dataset import SyntheticTokenDataset
+
+    ds = SyntheticTokenDataset(samples=config.dataset_size, seq_len=seq_len,
+                               vocab=vocab, seed=config.seed)
+    return task, ds
+
+
+@register("gpt-small")
+def _gpt_small(config: TrainingConfig):
+    """GPT-2-small causal LM on synthetic 1024-token sequences."""
+    from .gpt import CausalLmTask, gpt_small
+
+    seq_len, vocab = 1024, 50_257
+    task = CausalLmTask(gpt_small(dtype=_dtype(config), seq_len=seq_len,
+                                  vocab_size=vocab))
+    return _token_entry(config, task, seq_len, vocab)
+
+
+@register("gpt-tiny")
+def _gpt_tiny(config: TrainingConfig):
+    """2-layer GPT on short sequences — the CPU-CI causal-LM config."""
+    from .gpt import CausalLmTask, gpt_tiny
+
+    seq_len, vocab = 128, 1024
+    task = CausalLmTask(gpt_tiny(dtype=_dtype(config), seq_len=seq_len,
+                                 vocab_size=vocab))
+    return _token_entry(config, task, seq_len, vocab)
+
+
+@register("gpt-long")
+def _gpt_long(config: TrainingConfig, mesh=None):
+    """Long-context GPT (4096 tokens): causal ring attention over the
+    ``seq`` mesh axis when present."""
+    from ..runtime import make_mesh
+    from .gpt import CausalLmTask, gpt_long
+
+    import jax
+
+    if mesh is None:
+        mesh = make_mesh(config.mesh, jax.devices())
+    seq_len, vocab = 4096, 50_257
+    task = CausalLmTask(gpt_long(seq_len=seq_len, dtype=_dtype(config),
+                                 mesh=mesh, vocab_size=vocab))
+    return _token_entry(config, task, seq_len, vocab)
+
+
+@register("gpt-long-tiny")
+def _gpt_long_tiny(config: TrainingConfig, mesh=None):
+    """Test-sized long-context causal config (CPU-CI exercisable)."""
+    from ..runtime import make_mesh
+    from .gpt import CausalLmTask, gpt_long
+
+    import jax
+
+    if mesh is None:
+        mesh = make_mesh(config.mesh, jax.devices())
+    seq_len, vocab = 512, 1024
+    task = CausalLmTask(gpt_long(seq_len=seq_len, dtype=_dtype(config),
+                                 mesh=mesh, vocab_size=vocab, num_layers=2,
+                                 num_heads=2, head_dim=32, mlp_dim=128))
+    return _token_entry(config, task, seq_len, vocab)

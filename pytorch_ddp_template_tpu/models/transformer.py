@@ -55,6 +55,7 @@ class MultiHeadAttention(nn.Module):
     dropout_rate: float = 0.0
     attn_impl: str = "auto"  # Impl | "ring"
     mesh: jax.sharding.Mesh | None = None
+    causal: bool = False
 
     @nn.compact
     def __call__(self, x, mask=None, *, train: bool = True):
@@ -76,11 +77,17 @@ class MultiHeadAttention(nn.Module):
         if self.attn_impl == "ring":
             if self.mesh is None:
                 raise ValueError("attn_impl='ring' requires mesh")
+            if mask is not None:
+                raise ValueError(
+                    "ring attention does not support attention masks yet; "
+                    "pad-free packing or the blockwise impl handle masking"
+                )
             from ..parallel.ring import ring_attention
 
-            out = ring_attention(q, k, v, self.mesh)
+            out = ring_attention(q, k, v, self.mesh, causal=self.causal)
         else:
-            out = attention(q, k, v, mask=mask, impl=self.attn_impl)
+            out = attention(q, k, v, mask=mask, causal=self.causal,
+                            impl=self.attn_impl)
         out = nn.DenseGeneral(
             features,
             axis=(-2, -1),
@@ -128,6 +135,7 @@ class EncoderBlock(nn.Module):
     pre_norm: bool = True
     attn_impl: str = "auto"
     mesh: jax.sharding.Mesh | None = None
+    causal: bool = False
 
     @nn.compact
     def __call__(self, x, mask=None, train: bool = True):
@@ -136,7 +144,8 @@ class EncoderBlock(nn.Module):
         ln = lambda name: nn.LayerNorm(dtype=jnp.float32, name=name)
         attn = MultiHeadAttention(
             self.num_heads, self.head_dim, self.dtype,
-            self.dropout_rate, self.attn_impl, self.mesh, name="attention",
+            self.dropout_rate, self.attn_impl, self.mesh, self.causal,
+            name="attention",
         )
         mlp = MlpBlock(self.mlp_dim, self.dtype, self.dropout_rate, name="mlp")
         if self.pre_norm:
@@ -164,6 +173,7 @@ class TransformerEncoder(nn.Module):
     pre_norm: bool = True
     attn_impl: str = "auto"
     mesh: jax.sharding.Mesh | None = None
+    causal: bool = False
     remat: bool = False
 
     @nn.compact
@@ -175,7 +185,7 @@ class TransformerEncoder(nn.Module):
             block = block_cls(
                 self.num_heads, self.head_dim, self.mlp_dim, self.dtype,
                 self.dropout_rate, self.pre_norm, self.attn_impl, self.mesh,
-                name=f"layer_{layer}",
+                self.causal, name=f"layer_{layer}",
             )
             x = block(x, mask, train) if self.remat else block(
                 x, mask, train=train)

@@ -290,6 +290,7 @@ class Trainer:
 
         global_step = start_step
         window: list[jax.Array] = []
+        side_work = False  # True when the last iteration ran eval/save/etc.
         trace = TraceWindow(cfg.output_dir, start_step=start_step + 10,
                             num_steps=cfg.profile_steps)
         timer = StepTimer()
@@ -306,7 +307,10 @@ class Trainer:
             for batch in self.loader.epoch(epoch, start_batch=skip):
                 trace.step(global_step)
                 state, metrics = self.train_step(state, batch)
-                timer.tick()
+                # an interval that included eval/save/divergence work last
+                # iteration is not a step time — keep percentiles honest
+                timer.tick(discard=side_work)
+                side_work = False
                 global_step += 1
                 if cfg.logging_steps:  # window only consumed when logging
                     window.append(metrics["loss"])
@@ -333,6 +337,7 @@ class Trainer:
                     log.info("progress", {"step": global_step, **scalars})
 
                 if cfg.eval_steps and global_step % cfg.eval_steps == 0:
+                    side_work = True
                     ev = self.evaluate(state)
                     if ev:
                         self.metrics_writer.write(global_step, ev)
@@ -342,9 +347,11 @@ class Trainer:
                         and global_step % cfg.divergence_check_steps == 0):
                     # SPMD desync detector (utils/divergence.py): replicated
                     # state must fingerprint identically on every host
+                    side_work = True
                     divergence_check(state.params, step=global_step)
 
                 if cfg.save_steps and global_step % cfg.save_steps == 0:
+                    side_work = True
                     self.ckpt.save(global_step, state, cfg)
 
                 if global_step >= self.total_steps:

@@ -48,15 +48,18 @@ def check(tree: Any, *, step: int | None = None, raise_on_divergence: bool = Fal
     from jax.experimental import multihost_utils
 
     all_fps = np.asarray(multihost_utils.process_allgather(fp))
-    ok = bool((all_fps == all_fps[0]).all())
+    # bit-pattern comparison: NaN != NaN would misreport ordinary numeric
+    # blowup (same NaNs everywhere) as cross-host divergence
+    bits = all_fps.view(np.uint32)
+    ok = bool((bits == bits[0]).all())
     if not ok:
         detail = {
             "step": step,
             "process": jax.process_index(),
             "local_fp_head": fp[:4].tolist(),
             "divergent_processes": [
-                int(i) for i in range(len(all_fps))
-                if not (all_fps[i] == all_fps[0]).all()
+                int(i) for i in range(len(bits))
+                if not (bits[i] == bits[0]).all()
             ],
         }
         if raise_on_divergence:

@@ -69,15 +69,21 @@ class StepTimer:
         self._capacity = capacity
         self._last: float | None = None
 
-    def tick(self) -> float | None:
-        """Mark a step boundary; returns the last step's duration."""
+    def tick(self, *, discard: bool = False) -> float | None:
+        """Mark a step boundary; returns the last step's duration.
+
+        ``discard=True`` still advances the boundary but drops the interval
+        from the statistics — callers pass it when the interval included
+        non-step work (eval, checkpoint save, divergence allgather), which
+        would otherwise corrupt the p90/p99 step-time percentiles."""
         now = time.perf_counter()
         dt = None
         if self._last is not None:
             dt = now - self._last
-            if len(self._times) >= self._capacity:
-                self._times.pop(0)
-            self._times.append(dt)
+            if not discard:
+                if len(self._times) >= self._capacity:
+                    self._times.pop(0)
+                self._times.append(dt)
         self._last = now
         return dt
 

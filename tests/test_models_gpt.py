@@ -1,0 +1,80 @@
+"""GPT causal-LM family: loss semantics, the causality invariant (future
+tokens must not affect past logits) on every attention impl, and
+context-parallel causal training end-to-end."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_ddp_template_tpu.config import TrainingConfig
+from pytorch_ddp_template_tpu.models import build
+from pytorch_ddp_template_tpu.models.gpt import gpt_tiny
+
+
+def test_gpt_tiny_loss_near_uniform():
+    cfg = TrainingConfig(model="gpt-tiny", dataset_size=32)
+    task, ds = build("gpt-tiny", cfg)
+    batch = {k: jnp.asarray(v) for k, v in ds.batch(np.arange(8)).items()}
+    params, extra = task.init(jax.random.PRNGKey(0), batch)
+    loss, _, metrics = task.loss(params, extra, batch, jax.random.PRNGKey(1))
+    assert abs(float(loss) - np.log(1024)) < 0.5
+    assert 0.0 <= float(metrics["next_token_accuracy"]) <= 1.0
+
+
+@pytest.mark.parametrize("impl", ["xla", "blockwise", "flash"])
+def test_causality_invariant(impl):
+    """Changing token t must not change logits at positions < t."""
+    model = gpt_tiny(seq_len=64, vocab_size=128, attn_impl=impl)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 128, (2, 64)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids, train=False)["params"]
+    base = model.apply({"params": params}, ids, train=False)
+    ids2 = ids.at[:, 40:].set(7)  # rewrite the future
+    out2 = model.apply({"params": params}, ids2, train=False)
+    np.testing.assert_allclose(base[:, :40], out2[:, :40], atol=1e-4)
+    # sanity: the future DID change
+    assert not np.allclose(base[:, 40:], out2[:, 40:], atol=1e-4)
+
+
+def test_gpt_context_parallel_end_to_end(tmp_path):
+    """gpt-long-tiny (causal ring attention) through the full Trainer on a
+    data×seq mesh; causality holds under sequence sharding."""
+    from pytorch_ddp_template_tpu.runtime import make_mesh
+    from pytorch_ddp_template_tpu.runtime.context import RuntimeContext
+    from pytorch_ddp_template_tpu.train.engine import Trainer
+
+    cfg = TrainingConfig(
+        model="gpt-long-tiny", mesh="data:2,seq:4", dataset_size=64,
+        per_device_train_batch_size=1, max_steps=4, logging_steps=0,
+        save_steps=0, learning_rate=5e-3, max_grad_norm=1.0,
+        output_dir=str(tmp_path), resume=False,
+    )
+    mesh = make_mesh(cfg.mesh, jax.devices())
+    key = jax.random.PRNGKey(cfg.seed)
+    ctx = RuntimeContext(mesh=mesh, seed_key=key,
+                         host_key=jax.random.fold_in(key, 0), config=cfg)
+    task, ds = build(cfg.model, cfg, mesh=mesh)
+    state = Trainer(cfg, ctx, task, ds).train()
+    assert int(state.step) == 4
+
+
+def test_ring_causal_matches_blockwise_through_model():
+    """The same weights must give the same logits whether attention runs
+    ring-distributed over the seq axis or locally blockwise."""
+    from pytorch_ddp_template_tpu.runtime import make_mesh
+    from pytorch_ddp_template_tpu.models.gpt import gpt_long
+
+    mesh = make_mesh("data:2,seq:4", jax.devices())
+    ring_model = gpt_long(seq_len=64, vocab_size=128, mesh=mesh,
+                          num_layers=2, num_heads=2, head_dim=32, mlp_dim=64)
+    local_model = gpt_long(seq_len=64, vocab_size=128, mesh=None,
+                           num_layers=2, num_heads=2, head_dim=32, mlp_dim=64)
+    rng = np.random.default_rng(1)
+    ids = jnp.asarray(rng.integers(0, 128, (2, 64)), jnp.int32)
+    params = local_model.init(jax.random.PRNGKey(0), ids, train=False)["params"]
+    local = local_model.apply({"params": params}, ids, train=False)
+    ring = jax.jit(
+        lambda p, i: ring_model.apply({"params": p}, i, train=False)
+    )(params, ids)
+    np.testing.assert_allclose(local, np.asarray(ring), atol=2e-4)
