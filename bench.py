@@ -26,6 +26,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 # fp32 V100 numbers; see BENCH.md.
 BASELINE_PER_DEVICE = {
     "resnet50": ("resnet50_images_per_sec_per_chip", "images/sec/chip", 380.0),
+    "resnet18": ("resnet18_images_per_sec_per_chip", "images/sec/chip", 2200.0),
+    "bert-base": ("bert_base_seq512_per_sec_per_chip", "sequences/sec/chip", 35.0),
+    "vit-b16": ("vit_b16_images_per_sec_per_chip", "images/sec/chip", 100.0),
+    "gpt-small": ("gpt_small_seq1024_per_sec_per_chip", "sequences/sec/chip", 6.0),
     "mlp-wide": ("mlp_wide_examples_per_sec_per_chip", "examples/sec/chip", 1.0e6),
 }
 
@@ -36,7 +40,8 @@ PER_DEVICE_BATCH = int(os.environ.get("BENCH_BATCH", "0"))  # 0 = model default
 
 
 def default_batch(model: str) -> int:
-    return {"resnet50": 128, "mlp-wide": 4096}.get(model, 128)
+    return {"resnet50": 128, "resnet18": 512, "bert-base": 16, "vit-b16": 64,
+            "gpt-small": 8, "mlp-wide": 4096}.get(model, 128)
 
 
 def main() -> None:
@@ -88,7 +93,9 @@ def main() -> None:
         opt_state=tx.init(params),
         rng=jax.random.clone(seed_key),
     )
-    state = jax.device_put(state, NamedSharding(mesh, P()))
+    from pytorch_ddp_template_tpu.parallel import shard_tree
+
+    state = shard_tree(state, mesh)  # unbox + place per logical annotations
     train_step = make_train_step(task, tx, schedule, accum_steps=1)
 
     # Sync by fetching a real value: on some PJRT transports (e.g. the axon

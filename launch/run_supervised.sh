@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# Failure-recovery supervisor: restart-on-crash around the auto-resume path.
+#
+# The reference has no elastic/failure story (SURVEY.md §5.3): its
+# pre-elastic torch.distributed.launch hangs or dies on any rank failure,
+# and its checkpoints cannot be loaded. Here the trainer auto-resumes from
+# the latest checkpoint in --output_dir, so crash recovery is just
+# "run it again" — this wrapper does that with bounded retries and
+# exponential backoff, which is the honest TPU-pod equivalent of elastic
+# training (preemption-and-resume, the standard recovery model on TPUs).
+#
+# Usage: MAX_RESTARTS=5 ./launch/run_supervised.sh --model resnet50 ...
+
+set -u
+
+MAX_RESTARTS="${MAX_RESTARTS:-10}"
+BACKOFF="${BACKOFF_SECONDS:-5}"
+MIN_RUNTIME="${MIN_RUNTIME_SECONDS:-10}"
+
+attempt=0
+while true; do
+  start=$(date +%s)
+  python "$(dirname "$0")/../ddp.py" "$@"
+  code=$?
+  runtime=$(( $(date +%s) - start ))
+  if [ "$code" -eq 0 ]; then
+    echo "[supervisor] training completed" >&2
+    exit 0
+  fi
+  # exit 2 = argparse/config error; sub-MIN_RUNTIME first failure = broken
+  # setup, not a preemption — restarting cannot help either
+  if [ "$code" -eq 2 ] || { [ "$attempt" -eq 0 ] && [ "$runtime" -lt "$MIN_RUNTIME" ]; }; then
+    echo "[supervisor] non-recoverable failure (exit $code after ${runtime}s); not retrying" >&2
+    exit "$code"
+  fi
+  attempt=$((attempt + 1))
+  if [ "$attempt" -gt "$MAX_RESTARTS" ]; then
+    echo "[supervisor] giving up after $MAX_RESTARTS restarts (last exit $code)" >&2
+    exit "$code"
+  fi
+  echo "[supervisor] exit $code; restart $attempt/$MAX_RESTARTS in ${BACKOFF}s (auto-resume from latest checkpoint)" >&2
+  sleep "$BACKOFF"
+  BACKOFF=$((BACKOFF * 2))
+  [ "$BACKOFF" -gt 300 ] && BACKOFF=300
+done

@@ -75,6 +75,18 @@ class CheckpointManager:
     def all_steps(self) -> list[int]:
         return sorted(self._mngr.all_steps())
 
+    def read_config(self, step: int | None = None) -> dict | None:
+        """Read just the JSON config of a checkpoint (no state restore) —
+        used to validate template compatibility before StandardRestore."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            return None
+        restored = self._mngr.restore(
+            step, args=ocp.args.Composite(config=ocp.args.JsonRestore())
+        )
+        return restored["config"]
+
     def restore(self, step: int | None, template_state: Any) -> tuple[Any, dict]:
         """Restore ``(state, config_dict)``; ``step=None`` → latest.
 

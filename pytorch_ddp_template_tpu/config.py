@@ -52,6 +52,14 @@ class TrainingConfig:
 
     # -- TPU-native additions ---------------------------------------------
     learning_rate: float = 1e-3  # reference hardcodes SGD(lr=1e-3) at ddp.py:183
+    optimizer: str = "sgd"  # sgd | momentum | adam | adamw; the reference's
+    #                         --fp16 FusedAdam path is a NameError (SURVEY.md
+    #                         §2d) — here the adaptive family actually works
+    momentum: float = 0.9  # for optimizer=momentum
+    weight_decay: float = 0.0  # adamw decoupled weight decay
+    adam_beta1: float = 0.9
+    adam_beta2: float = 0.999
+    adam_eps: float = 1e-8
     mesh: str = "data:-1"  # mesh spec, e.g. "data:-1" or "data:4,model:2"
     coordinator_address: str | None = None  # jax.distributed rendezvous
     num_processes: int | None = None
@@ -117,6 +125,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="Accepted for compatibility; bf16 has a single policy.")
     # TPU-native additions --------------------------------------------------
     p.add_argument("--learning_rate", type=float, default=1e-3)
+    p.add_argument("--optimizer", type=str, default="sgd",
+                   choices=["sgd", "momentum", "adam", "adamw"])
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--weight_decay", type=float, default=0.0)
+    p.add_argument("--adam_beta1", type=float, default=0.9)
+    p.add_argument("--adam_beta2", type=float, default=0.999)
+    p.add_argument("--adam_eps", type=float, default=1e-8)
     p.add_argument("--mesh", type=str, default="data:-1")
     p.add_argument("--coordinator_address", type=str, default=None)
     p.add_argument("--num_processes", type=int, default=None)

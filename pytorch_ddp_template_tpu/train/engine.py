@@ -56,15 +56,35 @@ class TrainState(flax.struct.PyTreeNode):
 
 
 def make_optimizer(config: TrainingConfig, total_steps: int) -> tuple[optax.GradientTransformation, optax.Schedule]:
-    """clip_by_global_norm → SGD(warmup-linear) — the reference's update
-    rule (clip ``ddp.py:238-239``, ``optim.SGD(lr=1e-3)`` ``ddp.py:183``,
-    schedule ``ddp.py:52-61``) as one optax chain."""
+    """clip_by_global_norm → optimizer(warmup-linear) as one optax chain.
+
+    Default matches the reference's update rule (clip ``ddp.py:238-239``,
+    ``optim.SGD(lr=1e-3)`` ``ddp.py:183``, schedule ``ddp.py:52-61``).
+    The adaptive family replaces the reference's fp16 FusedAdam path,
+    which never ran (unimported ``FusedSGD`` NameError, SURVEY.md §2d).
+    Optimizer state (momentum/adam moments) mirrors the param tree, so
+    ``parallel.shard_tree`` places it with the params' shardings under
+    tensor parallelism."""
     schedule = linear_schedule_with_warmup(
         config.learning_rate, config.warmup_steps, total_steps
     )
+    kind = config.optimizer
+    if kind == "sgd":
+        opt = optax.sgd(learning_rate=schedule)
+    elif kind == "momentum":
+        opt = optax.sgd(learning_rate=schedule, momentum=config.momentum)
+    elif kind == "adam":
+        opt = optax.adam(learning_rate=schedule, b1=config.adam_beta1,
+                         b2=config.adam_beta2, eps=config.adam_eps)
+    elif kind == "adamw":
+        opt = optax.adamw(learning_rate=schedule, b1=config.adam_beta1,
+                          b2=config.adam_beta2, eps=config.adam_eps,
+                          weight_decay=config.weight_decay)
+    else:
+        raise ValueError(f"unknown optimizer {kind!r}")
     tx = optax.chain(
         optax.clip_by_global_norm(config.max_grad_norm),
-        optax.sgd(learning_rate=schedule),
+        opt,
     )
     return tx, schedule
 
@@ -241,6 +261,17 @@ class Trainer:
                 f"under {self.ckpt.directory}"
             )
         if (want is not None or self.config.resume) and self.ckpt.latest_step() is not None:
+            saved = self.ckpt.read_config(want) or {}
+            saved_opt = saved.get("optimizer")
+            if saved_opt is not None and saved_opt != self.config.optimizer:
+                # fail with intent, not an opaque orbax pytree mismatch:
+                # the opt_state template cannot match a different optimizer
+                raise ValueError(
+                    f"checkpoint at step {want or self.ckpt.latest_step()} was "
+                    f"trained with --optimizer {saved_opt}, current run uses "
+                    f"{self.config.optimizer}; pass --no_resume or a fresh "
+                    "--output_dir to start over"
+                )
             state, _ = self.ckpt.restore(want, state)
             return state, int(state.step)
         return state, 0
