@@ -2,24 +2,30 @@
 
 Measures sustained training throughput (examples/sec/chip) of the flagship
 config on the available hardware, steady-state (post-compile), end-to-end
-through the jitted train step.
+through the jitted train step, plus MFU (model FLOPs utilisation) from the
+compiled executable's own cost analysis.
 
 ``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
 ratio is against the documented era-appropriate target below for the metric
 BASELINE.json names (ResNet-50 images/sec/chip on the reference's V100
 hardware hints); >1.0 means this framework beats that bar per chip.
+
+Robustness contract (this file is a driver hook): in this environment only
+one process can hold the TPU at a time and backend setup can fail with
+UNAVAILABLE — init retries with backoff, and ANY hard failure still emits a
+single parseable JSON line (``value: 0`` + ``error``) instead of a stack
+trace. Env knobs: BENCH_MODEL / BENCH_STEPS / BENCH_WARMUP / BENCH_BATCH /
+BENCH_CPU=1 (force the CPU backend — the axon TPU plugin ignores the
+JAX_PLATFORMS env var, so tests must force via the config API).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
 import time
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
+import traceback
 
 # Era-appropriate per-device reference throughputs (the reference targeted
 # 4xV100 nodes, run.sbatch:2-9). Values are the well-known MLPerf-era
@@ -33,6 +39,16 @@ BASELINE_PER_DEVICE = {
     "mlp-wide": ("mlp_wide_examples_per_sec_per_chip", "examples/sec/chip", 1.0e6),
 }
 
+# Peak dense-matmul throughput per chip (bf16), for MFU. Sources: public
+# TPU spec sheets; GPU entries cover dev boxes so MFU stays meaningful.
+PEAK_FLOPS = {
+    "TPU v5e": 197e12,
+    "TPU v5 lite": 197e12,
+    "TPU v4": 275e12,
+    "TPU v3": 123e12,
+    "TPU v2": 45e12,
+}
+
 MODEL = os.environ.get("BENCH_MODEL", "resnet50")
 WARMUP_STEPS = int(os.environ.get("BENCH_WARMUP", "5"))
 TIMED_STEPS = int(os.environ.get("BENCH_STEPS", "30"))
@@ -44,9 +60,125 @@ def default_batch(model: str) -> int:
             "gpt-small": 8, "mlp-wide": 4096}.get(model, 128)
 
 
-def main() -> None:
+def _emit(payload: dict) -> None:
+    print(json.dumps(payload), flush=True)
+
+
+def _fail(metric: str, unit: str, err: BaseException) -> None:
+    """Hard failure → still one parseable JSON line (value 0, diagnosable)."""
+    _emit({
+        "metric": metric,
+        "value": 0.0,
+        "unit": unit,
+        "vs_baseline": 0.0,
+        "error": f"{type(err).__name__}: {err}",
+    })
+    traceback.print_exc(file=sys.stderr)
+
+
+def _tunnel_listening(ports=(8082, 8083), timeout_s: float = 2.0) -> bool:
+    """True if the TPU tunnel relay accepts TCP connections."""
+    import socket
+
+    for port in ports:
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=timeout_s).close()
+            return True
+        except OSError:
+            continue
+    return False
+
+
+def init_devices(max_tries: int = 6, delay_s: float = 10.0):
+    """Backend init with bounded retry and a no-hang guarantee.
+
+    Failure modes seen in this environment: (a) UNAVAILABLE — the tunnel
+    admits one client at a time, so a bench started while another process
+    drains off the chip fails setup (clear backend state, back off, retry);
+    (b) the relay process is dead — the plugin then blocks on reconnect
+    *forever*, so pre-check the relay port and bound each init attempt with
+    SIGALRM rather than hang to an opaque driver timeout.
+    """
+    import signal
+
+    import jax
+
+    import importlib.util
+
+    if os.environ.get("BENCH_CPU", "") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    elif importlib.util.find_spec("axon") is not None:
+        # the axon plugin registers itself regardless of JAX_PLATFORMS (it
+        # ignores that env var), so gate the dead-relay pre-check on the
+        # plugin being importable, not on the env
+        deadline = time.time() + float(os.environ.get("BENCH_TUNNEL_WAIT", "60"))
+        while not _tunnel_listening():
+            if time.time() > deadline:
+                raise RuntimeError(
+                    "TPU tunnel relay not listening on 127.0.0.1:8082 — "
+                    "backend init would hang; aborting with a parseable error"
+                )
+            time.sleep(5)
+
+    def _alarm(signum, frame):  # noqa: ARG001
+        raise TimeoutError("backend init exceeded per-attempt deadline")
+
+    last: BaseException | None = None
+    for attempt in range(max_tries):
+        try:
+            if hasattr(signal, "SIGALRM"):
+                signal.signal(signal.SIGALRM, _alarm)
+                signal.alarm(int(os.environ.get("BENCH_INIT_TIMEOUT", "120")))
+            try:
+                return jax.devices()
+            finally:
+                if hasattr(signal, "SIGALRM"):
+                    signal.alarm(0)
+        except (RuntimeError, TimeoutError) as e:  # UNAVAILABLE / setup fail
+            last = e
+            retryable = isinstance(e, TimeoutError) or (
+                "UNAVAILABLE" in str(e) or "initialize" in str(e).lower()
+            )
+            if not retryable:
+                raise
+            try:  # reset cached-failed backend so the retry re-inits
+                jax.clear_backends()
+            except Exception:  # noqa: BLE001
+                try:
+                    from jax._src import xla_bridge
+
+                    xla_bridge._clear_backends()  # noqa: SLF001
+                except Exception:  # noqa: BLE001
+                    pass
+            if attempt + 1 < max_tries:
+                print(f"backend UNAVAILABLE (attempt {attempt + 1}/{max_tries}), "
+                      f"retrying in {delay_s:.0f}s", file=sys.stderr)
+                time.sleep(delay_s)
+                delay_s *= 1.5
+    raise last  # type: ignore[misc]
+
+
+def _flops_of(compiled) -> float | None:
+    """Model FLOPs of one optimizer step from XLA's own cost analysis."""
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        flops = float(cost.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:  # noqa: BLE001 - cost analysis is best-effort
+        return None
+
+
+def run_bench(model: str, metric: str, unit: str, baseline: float) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
     from pytorch_ddp_template_tpu.config import TrainingConfig
-    from pytorch_ddp_template_tpu.models import available_models, build
+    from pytorch_ddp_template_tpu.models import build
+    from pytorch_ddp_template_tpu.parallel import shard_tree
     from pytorch_ddp_template_tpu.runtime import make_mesh
     from pytorch_ddp_template_tpu.runtime.context import RuntimeContext
     from pytorch_ddp_template_tpu.train.engine import (
@@ -55,12 +187,7 @@ def main() -> None:
         make_train_step,
     )
 
-    model = MODEL if MODEL in available_models() else "mlp-wide"
-    metric, unit, baseline = BASELINE_PER_DEVICE.get(
-        model, (f"{model}_examples_per_sec_per_chip", "examples/sec/chip", 1.0)
-    )
     per_device = PER_DEVICE_BATCH or default_batch(model)
-
     n_dev = jax.device_count()
     mesh = make_mesh("data:-1")
     config = TrainingConfig(
@@ -93,10 +220,13 @@ def main() -> None:
         opt_state=tx.init(params),
         rng=jax.random.clone(seed_key),
     )
-    from pytorch_ddp_template_tpu.parallel import shard_tree
-
     state = shard_tree(state, mesh)  # unbox + place per logical annotations
-    train_step = make_train_step(task, tx, schedule, accum_steps=1)
+    # AOT-compile once and drive the loops with the same executable — a
+    # plain call would trace+compile the identical program a second time
+    train_step = make_train_step(task, tx, schedule, accum_steps=1).lower(
+        state, batch
+    ).compile()
+    step_flops = _flops_of(train_step)
 
     # Sync by fetching a real value: on some PJRT transports (e.g. the axon
     # tunnel) block_until_ready can return before compute has finished,
@@ -104,7 +234,8 @@ def main() -> None:
     # depends on every step cannot lie.
     for _ in range(WARMUP_STEPS):
         state, metrics = train_step(state, batch)
-    assert np.isfinite(float(metrics["loss"]))
+    if WARMUP_STEPS:
+        assert np.isfinite(float(metrics["loss"]))
 
     t0 = time.perf_counter()
     for _ in range(TIMED_STEPS):
@@ -115,12 +246,44 @@ def main() -> None:
 
     examples_per_sec = TIMED_STEPS * global_batch / dt
     per_chip = examples_per_sec / n_dev
-    print(json.dumps({
+    out = {
         "metric": metric,
         "value": round(per_chip, 2),
         "unit": unit,
         "vs_baseline": round(per_chip / baseline, 4),
-    }))
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "n_devices": n_dev,
+        "global_batch": global_batch,
+        "step_time_ms": round(1000 * dt / TIMED_STEPS, 2),
+    }
+    if step_flops is not None:
+        kind = jax.devices()[0].device_kind
+        peak = next((v for k, v in PEAK_FLOPS.items() if k in kind), None)
+        out["tflops_per_sec_per_chip"] = round(
+            step_flops * TIMED_STEPS / dt / n_dev / 1e12, 2
+        )
+        if peak is not None:
+            out["mfu"] = round(step_flops * TIMED_STEPS / dt / (n_dev * peak), 4)
+    return out
+
+
+def main() -> None:
+    metric, unit, _ = BASELINE_PER_DEVICE.get(
+        MODEL, (f"{MODEL}_examples_per_sec_per_chip", "examples/sec/chip", 1.0)
+    )
+    try:
+        init_devices()
+        from pytorch_ddp_template_tpu.models import available_models
+
+        model = MODEL if MODEL in available_models() else "mlp-wide"
+        metric, unit, baseline = BASELINE_PER_DEVICE.get(
+            model, (f"{model}_examples_per_sec_per_chip", "examples/sec/chip", 1.0)
+        )
+        _emit(run_bench(model, metric, unit, baseline))
+    except BaseException as e:  # noqa: BLE001 - JSON-or-bust driver contract
+        _fail(metric, unit, e)
+        sys.exit(1)
 
 
 if __name__ == "__main__":

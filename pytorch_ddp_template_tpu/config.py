@@ -72,15 +72,30 @@ class TrainingConfig:
     divergence_check_steps: int = 0  # cross-host param fingerprint every N steps (§5.2)
 
     @property
-    def train_batch_size(self) -> int:
-        """Global batch per optimizer micro-step across all devices.
+    def data_axis_size(self) -> int:
+        """Number of data-parallel replicas under ``self.mesh``.
 
-        Reference computes ``per_gpu * max(1, n_gpu)`` (``ddp.py:110-111``);
-        on TPU the multiplier is the global device count.
+        Delegates to the runtime's canonical mesh-spec parser (lazy import:
+        ``runtime.context`` imports this module at its top level), so a spec
+        that cannot build a mesh fails here too instead of silently flooring.
         """
         import jax
 
-        return self.per_device_train_batch_size * jax.device_count()
+        from .runtime.context import parse_mesh_spec
+
+        return parse_mesh_spec(self.mesh, jax.device_count()).get("data", 1)
+
+    @property
+    def train_batch_size(self) -> int:
+        """Global batch per optimizer micro-step across all *replicas*.
+
+        Reference computes ``per_gpu * max(1, n_gpu)`` (``ddp.py:110-111``)
+        — batch scales with the number of replicas. On a pure-DP mesh every
+        chip is a replica; under tensor/sequence parallelism a replica is a
+        model×seq device group, so the multiplier is the ``data`` axis size,
+        not the global device count.
+        """
+        return self.per_device_train_batch_size * self.data_axis_size
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)

@@ -103,8 +103,8 @@ def test_context_parallel_end_to_end(tmp_path):
         output_dir=str(tmp_path), eval_steps=0, resume=False,
     )
     mesh = make_mesh(cfg.mesh, jax.devices())
-    # per_device=1 over data:2 -> global micro batch 2... but train_batch_size
-    # uses device_count (8); with data=2 the batch dim splits 2-way.
+    # per_device=1 over data:2 -> global micro batch 2 (train_batch_size
+    # scales by the data-axis size; the seq:4 group shares each sample)
     task, ds = build(cfg.model, cfg)
     ctx = _ctx(mesh, cfg)
     trainer = Trainer(cfg, ctx, task, ds)
@@ -143,6 +143,33 @@ def test_sharded_grads_equal_single_device_grads():
     sharded = jax.jit(grads_of)(sharded_batch)
     for a, b in zip(jax.tree.leaves(single), jax.tree.leaves(sharded)):
         np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_train_batch_size_scales_with_data_axis_only():
+    """``per_device_train_batch_size`` means per *replica* (reference
+    semantics, ddp.py:110-111: batch scales with the number of replicas) —
+    under tensor/sequence parallelism a replica is a model×seq device
+    group, so the multiplier is the data-axis size, not device_count."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    cfg = TrainingConfig(per_device_train_batch_size=3,
+                         mesh="data:2,model:2,seq:2")
+    assert cfg.data_axis_size == 2
+    assert cfg.train_batch_size == 6  # not 3 * device_count() == 24
+
+    # wildcard axes resolve against the device count (8 on this harness)
+    assert TrainingConfig(per_device_train_batch_size=3,
+                          mesh="data:-1").train_batch_size == 24
+    assert TrainingConfig(per_device_train_batch_size=3,
+                          mesh="data:-1,model:2").train_batch_size == 12
+
+    # each data shard holds exactly per_device samples on the 3-axis mesh
+    mesh = make_mesh(cfg.mesh, jax.devices())
+    batch = jax.device_put(
+        jnp.zeros((cfg.train_batch_size, 4)), NamedSharding(mesh, P("data"))
+    )
+    shard_rows = {s.data.shape[0] for s in batch.addressable_shards}
+    assert shard_rows == {cfg.per_device_train_batch_size}
 
 
 def test_describe_and_rules():
