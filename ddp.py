@@ -40,7 +40,7 @@ def make_eval_dataset(config, train_ds):
     if isinstance(train_ds, SyntheticTokenDataset):
         return SyntheticTokenDataset(
             samples=n, seq_len=train_ds.arrays["input_ids"].shape[1],
-            vocab=train_ds.vocab, seed=eval_seed,
+            vocab=train_ds.vocab, seed=eval_seed, padded=train_ds.padded,
         )
     if isinstance(train_ds, SyntheticRegressionDataset):
         return SyntheticRegressionDataset(samples=n, seed=eval_seed)

@@ -126,12 +126,24 @@ class SyntheticImageDataset:
 
 class SyntheticTokenDataset(ArrayDataset):
     """Synthetic token sequences for the language configs (BERT MLM ladder):
-    int32 token ids in ``[0, vocab)``, deterministic in ``seed``."""
+    int32 token ids in ``[0, vocab)``, deterministic in ``seed``.
+
+    ``padded=True`` emits variable-length sequences (uniform in
+    ``[seq_len//2, seq_len]``) padded with token 0 plus an int32
+    ``attention_mask`` (1 = real token) — the padded-batch shape real
+    tokenised corpora produce, exercised by the long-context rungs."""
 
     def __init__(self, samples: int = 10_000, seq_len: int = 128, vocab: int = 30_522,
-                 seed: int = 0):
+                 seed: int = 0, padded: bool = False):
         rng = np.random.default_rng(seed)
-        super().__init__(
-            input_ids=rng.integers(0, vocab, (samples, seq_len), dtype=np.int32),
-        )
+        ids = rng.integers(0, vocab, (samples, seq_len), dtype=np.int32)
+        arrays = {"input_ids": ids}
+        self.padded = padded
+        if padded:
+            lengths = rng.integers(max(1, seq_len // 2), seq_len + 1,
+                                   (samples,))
+            mask = (np.arange(seq_len)[None, :] < lengths[:, None])
+            arrays["input_ids"] = ids * mask
+            arrays["attention_mask"] = mask.astype(np.int32)
+        super().__init__(**arrays)
         self.vocab = vocab

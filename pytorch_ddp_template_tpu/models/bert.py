@@ -111,9 +111,11 @@ class MlmTask(Task):
     mask_rate = 0.15
     #: sequence dim of each batch key — the loader shards it over the
     #: ``seq`` mesh axis when context parallelism is on
-    seq_dims = {"input_ids": 1}
+    seq_dims = {"input_ids": 1, "attention_mask": 1}
 
     def model_inputs(self, batch):
+        if "attention_mask" in batch:
+            return (batch["input_ids"], batch["attention_mask"])
         return (batch["input_ids"],)
 
     def _corrupt(self, input_ids, rng, vocab):
@@ -129,17 +131,24 @@ class MlmTask(Task):
 
     def loss(self, params, extra_vars, batch, rng, *, train=True):
         input_ids = batch["input_ids"]
+        attention_mask = batch.get("attention_mask")
         vocab = self.model.vocab_size
         if rng is None:  # eval: deterministic masking keyed on nothing
             rng = jax.random.PRNGKey(0)
         mask_rng, dropout_rng = jax.random.split(rng)
         corrupted, selected = self._corrupt(input_ids, mask_rng, vocab)
+        if attention_mask is not None:
+            # padded positions: never corrupted, never scored
+            selected = selected & attention_mask.astype(bool)
+            corrupted = jnp.where(attention_mask.astype(bool), corrupted,
+                                  input_ids)
 
         variables = {"params": params, **extra_vars}
         kwargs = {"train": train}
         if train:
             kwargs["rngs"] = {"dropout": dropout_rng}
-        logits = self.model.apply(variables, corrupted, **kwargs)
+        logits = self.model.apply(variables, corrupted, attention_mask,
+                                  **kwargs)
 
         logp = jax.nn.log_softmax(logits, axis=-1)
         token_logp = jnp.take_along_axis(

@@ -169,8 +169,9 @@ def _bert_long(config: TrainingConfig, mesh=None):
     seq_len, vocab = 4096, 30_522
     task = MlmTask(bert_long(seq_len=seq_len, dtype=_dtype(config), mesh=mesh,
                              vocab_size=vocab))
+    # padded batches: the ring path consumes the key-padding mask natively
     ds = SyntheticTokenDataset(samples=config.dataset_size, seq_len=seq_len,
-                               vocab=vocab, seed=config.seed)
+                               vocab=vocab, seed=config.seed, padded=True)
     return task, ds
 
 
@@ -191,7 +192,7 @@ def _bert_long_tiny(config: TrainingConfig, mesh=None):
                              vocab_size=vocab, num_layers=2, num_heads=2,
                              head_dim=32, mlp_dim=128))
     ds = SyntheticTokenDataset(samples=config.dataset_size, seq_len=seq_len,
-                               vocab=vocab, seed=config.seed)
+                               vocab=vocab, seed=config.seed, padded=True)
     return task, ds
 
 

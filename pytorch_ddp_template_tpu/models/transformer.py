@@ -77,14 +77,21 @@ class MultiHeadAttention(nn.Module):
         if self.attn_impl == "ring":
             if self.mesh is None:
                 raise ValueError("attn_impl='ring' requires mesh")
+            kv_mask = None
             if mask is not None:
-                raise ValueError(
-                    "ring attention does not support attention masks yet; "
-                    "pad-free packing or the blockwise impl handle masking"
-                )
+                # key-padding masks (B, 1, 1, T) ride the ring as a (B, T)
+                # kv-validity vector rotated with its kv chunk; arbitrary
+                # (S, T) masks would need both dims sharded — unsupported
+                if mask.ndim != 4 or mask.shape[1] != 1 or mask.shape[2] != 1:
+                    raise ValueError(
+                        "ring attention supports key-padding masks of shape "
+                        f"(B, 1, 1, T) only; got {mask.shape}"
+                    )
+                kv_mask = mask[:, 0, 0, :]
             from ..parallel.ring import ring_attention
 
-            out = ring_attention(q, k, v, self.mesh, causal=self.causal)
+            out = ring_attention(q, k, v, self.mesh, causal=self.causal,
+                                 kv_mask=kv_mask)
         else:
             out = attention(q, k, v, mask=mask, causal=self.causal,
                             impl=self.attn_impl)

@@ -46,12 +46,16 @@ def ring_attention_local(
     axis_name: str,
     *,
     causal: bool = False,
+    kv_mask: jax.Array | None = None,
 ) -> jax.Array:
     """Per-shard body: call INSIDE ``shard_map`` (or ``pjit``-of-shard_map).
 
     Args:
       q, k, v: local chunks ``(B, S_local, H, D)`` of the globally
         ``(B, S, H, D)``-shaped arrays, sequence-sharded over ``axis_name``.
+      kv_mask: optional bool ``(B, S_local)`` validity of the *local keys*
+        (padding support); it rotates around the ring with its kv chunk so
+        each shard masks remote chunks correctly.
     Returns the local output chunk ``(B, S_local, H, D)``.
     """
     n = lax.axis_size(axis_name)
@@ -60,9 +64,10 @@ def ring_attention_local(
     scale = d ** -0.5
     qf = (q.astype(jnp.float32) * scale).transpose(0, 2, 1, 3)  # (B,H,S,D)
     perm = [(i, (i + 1) % n) for i in range(n)]
+    has_mask = kv_mask is not None
 
     def body(carry, r):
-        state, kc, vc = carry
+        state, kc, vc, mc = carry if has_mask else (*carry, None)
         src = (my - r) % n  # origin shard of the chunk we currently hold
         state = online_softmax_update(
             state,
@@ -72,15 +77,20 @@ def ring_attention_local(
             q_offset=my * s_loc,
             k_offset=src * s_loc,
             causal=causal,
+            mask_block=None if mc is None else mc[:, None, None, :],
         )
         # rotate AFTER consuming; XLA overlaps this ppermute with the next
         # iteration's compute (it has no data dependence on the update)
+        if has_mask:
+            kc, vc, mc = lax.ppermute((kc, vc, mc), axis_name, perm)
+            return (state, kc, vc, mc), None
         kc, vc = lax.ppermute((kc, vc), axis_name, perm)
         return (state, kc, vc), None
 
     state = online_softmax_init(b, h, s_loc, d)
-    (state, _, _), _ = lax.scan(body, (state, k, v), jnp.arange(n))
-    return online_softmax_finish(state, q.dtype).transpose(0, 2, 1, 3)
+    init = (state, k, v, kv_mask) if has_mask else (state, k, v)
+    carry, _ = lax.scan(body, init, jnp.arange(n))
+    return online_softmax_finish(carry[0], q.dtype).transpose(0, 2, 1, 3)
 
 
 def ring_attention(
@@ -91,6 +101,7 @@ def ring_attention(
     *,
     causal: bool = False,
     batch_axis: str | None = None,
+    kv_mask: jax.Array | None = None,
 ) -> jax.Array:
     """Ring attention over globally-shaped ``(B, S, H, D)`` arrays.
 
@@ -98,6 +109,9 @@ def ring_attention(
     over ``batch_axis`` (defaults to the mesh's data axis if present) and
     the sequence dim over ``seq``. Safe to call under an enclosing ``jit``:
     GSPMD sees a manual region and stitches shardings at the boundary.
+
+    ``kv_mask``: optional bool ``(B, S)`` key validity (True keeps) —
+    padded batches; sharded over ``seq`` like the kv it masks.
     """
     from ..runtime.context import DATA_AXIS, MODEL_AXIS
 
@@ -111,7 +125,18 @@ def ring_attention(
     heads_axis = MODEL_AXIS if model_size > 1 and q.shape[2] % model_size == 0 else None
     spec = P(batch_axis, SEQ_AXIS, heads_axis, None)
 
-    fn = functools.partial(ring_attention_local, axis_name=SEQ_AXIS,
-                           causal=causal)
-    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_vma=False)(q, k, v)
+    if kv_mask is None:
+        fn = functools.partial(ring_attention_local, axis_name=SEQ_AXIS,
+                               causal=causal)
+        return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+    def fn(q, k, v, m):
+        return ring_attention_local(q, k, v, axis_name=SEQ_AXIS,
+                                    causal=causal, kv_mask=m)
+
+    mask_spec = P(batch_axis, SEQ_AXIS)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec, mask_spec),
+                     out_specs=spec, check_vma=False)(
+        q, k, v, kv_mask.astype(bool)
+    )
