@@ -14,6 +14,8 @@ import sys
 
 from pytorch_ddp_template_tpu import parse_args
 from pytorch_ddp_template_tpu.data import (
+    MemmapDataset,
+    Subset,
     SyntheticImageDataset,
     SyntheticRegressionDataset,
     SyntheticTokenDataset,
@@ -26,25 +28,35 @@ from pytorch_ddp_template_tpu.utils import get_logger
 log = get_logger("ddp")
 
 
-def make_eval_dataset(config, train_ds):
-    """A held-out synthetic split: same distribution, different seed."""
+def train_eval_split(config, train_ds):
+    """``(train_ds, eval_ds)``: a held-out split for evaluation.
+
+    Synthetic sources regenerate with a different seed (same distribution,
+    disjoint stream); file-backed stores hold out their tail rows — the
+    rung where held-out eval matters most must not silently skip it.
+    """
     eval_seed = config.seed + 10_000
     n = max(128, config.train_batch_size)
+    if isinstance(train_ds, MemmapDataset):
+        held = min(max(n, len(train_ds) // 10), len(train_ds) // 2)
+        split = len(train_ds) - held
+        return (Subset(train_ds, 0, split),
+                Subset(train_ds, split, len(train_ds)))
     if isinstance(train_ds, SyntheticImageDataset):
-        return SyntheticImageDataset(
+        return train_ds, SyntheticImageDataset(
             samples=n,
             image_size=train_ds.image_size,
             num_classes=train_ds.num_classes,
             seed=eval_seed,
         )
     if isinstance(train_ds, SyntheticTokenDataset):
-        return SyntheticTokenDataset(
+        return train_ds, SyntheticTokenDataset(
             samples=n, seq_len=train_ds.arrays["input_ids"].shape[1],
             vocab=train_ds.vocab, seed=eval_seed, padded=train_ds.padded,
         )
     if isinstance(train_ds, SyntheticRegressionDataset):
-        return SyntheticRegressionDataset(samples=n, seed=eval_seed)
-    return None
+        return train_ds, SyntheticRegressionDataset(samples=n, seed=eval_seed)
+    return train_ds, None
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -52,7 +64,9 @@ def main(argv: list[str] | None = None) -> int:
     ctx = init(config)
     try:
         task, dataset = build(config.model, config)
-        eval_ds = make_eval_dataset(config, dataset) if config.eval_steps else None
+        eval_ds = None
+        if config.eval_steps:
+            dataset, eval_ds = train_eval_split(config, dataset)
         trainer = Trainer(config, ctx, task, dataset, eval_dataset=eval_ds)
         state = trainer.train()
         if eval_ds is not None:

@@ -66,6 +66,8 @@ class TrainingConfig:
     process_id: int | None = None
     model: str = "mlp"  # model-zoo key (models/registry.py)
     dataset_size: int = 100_000  # reference: FooDataset(100000) at ddp.py:135
+    data_dir: str | None = None  # file-backed store (data/filestore.py); None = synthetic
+    augment: str = "none"  # on-device augmentation: none | flip | crop-flip
     eval_steps: int = 0  # 0 disables; reference evaluate() is a stub (ddp.py:123-124)
     resume: bool = True  # auto-resume from latest checkpoint in output_dir
     profile_steps: int = 0  # trace steps [10, 10+N) to output_dir/profile (SURVEY.md §5.1)
@@ -153,6 +155,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--process_id", type=int, default=None)
     p.add_argument("--model", type=str, default="mlp")
     p.add_argument("--dataset_size", type=int, default=100_000)
+    p.add_argument("--data_dir", type=str, default=None,
+                   help="Train from a memory-mapped array store instead of "
+                        "synthetic data (see data/filestore.py).")
+    p.add_argument("--augment", type=str, default="none",
+                   choices=["none", "flip", "crop-flip"],
+                   help="On-device image augmentation inside the jitted step.")
     p.add_argument("--eval_steps", type=int, default=0)
     p.add_argument("--no_resume", dest="resume", action="store_false")
     p.add_argument("--profile_steps", type=int, default=0,

@@ -1,22 +1,29 @@
 """Data pipeline: datasets, sharded sampling, per-host loading."""
 
 from .dataset import (
+    Subset,
     ArrayDataset,
     Dataset,
     SyntheticImageDataset,
     SyntheticRegressionDataset,
     SyntheticTokenDataset,
 )
+from .filestore import MemmapDataset, StoreWriter, materialize, write_store
 from .loader import ShardedLoader
 from .sampler import epoch_batches, shard_indices
 
 __all__ = [
     "ArrayDataset",
     "Dataset",
+    "Subset",
+    "MemmapDataset",
+    "StoreWriter",
     "SyntheticImageDataset",
     "SyntheticRegressionDataset",
     "SyntheticTokenDataset",
     "ShardedLoader",
+    "materialize",
     "shard_indices",
     "epoch_batches",
+    "write_store",
 ]

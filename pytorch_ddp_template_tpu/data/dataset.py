@@ -55,6 +55,33 @@ class ArrayDataset:
         return {k: v[indices] for k, v in self.arrays.items()}
 
 
+class Subset:
+    """Contiguous-range view over any :class:`Dataset` (zero copy).
+
+    The train/eval split for file-backed stores: hold out the tail rows
+    without duplicating bytes on disk or in RAM.
+    """
+
+    def __init__(self, base: "Dataset", start: int, stop: int):
+        if not (0 <= start <= stop <= len(base)):
+            raise ValueError(
+                f"subset [{start}, {stop}) out of range for {len(base)} samples"
+            )
+        self.base = base
+        self.start = start
+        self._len = stop - start
+
+    def __len__(self) -> int:
+        return self._len
+
+    def batch(self, indices: np.ndarray) -> Mapping[str, np.ndarray]:
+        indices = np.asarray(indices)
+        if len(indices) and (indices.min() < -self._len
+                             or indices.max() >= self._len):
+            raise IndexError(f"index out of range [0, {self._len})")
+        return self.base.batch(self.start + indices % self._len)
+
+
 class SyntheticRegressionDataset(ArrayDataset):
     """The ``FooDataset`` equivalent (``dataset.py:6-17``): ``samples``
     standard-normal pairs ``x ∈ R^{in_dim}``, ``y ∈ R^{out_dim}``.

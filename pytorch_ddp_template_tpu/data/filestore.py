@@ -1,0 +1,175 @@
+"""File-backed datasets: raw memory-mapped array stores.
+
+The reference's data layer is host-RAM only (``/root/reference/
+dataset.py:6-17`` pre-materialises tensors; ``ddp.py:148-152`` feeds them
+through a ``DataLoader``) — fine for a toy, but the BASELINE ladder's
+ImageNet-class rungs need data that outlives RAM. TPU-first design:
+
+- **Storage is raw fixed-shape arrays, memory-mapped.** No TFRecord/proto
+  decode on the hot path: the classic TPU input bottleneck is host CPU
+  (SURVEY.md §7 hard part (e)), so the host's only per-batch work is a
+  threaded row gather (``native/native.cc ddp_gather_rows``) straight out
+  of the page cache into the staging buffer. uint8 images ship over PCIe
+  4x cheaper than f32; normalisation/augmentation run *on device* inside
+  the jitted step (``models/task.py``), where they fuse into the fwd pass.
+- **One ``.bin`` per key + ``meta.json``** (dtype/shape/sample count).
+  Files are plain C-order arrays — writable from any tool, inspectable
+  with ``np.memmap``, shardable by byte ranges for multi-host later.
+- **Streaming writer** so ImageNet-scale stores can be materialised chunk
+  by chunk without ever holding the dataset in RAM.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Iterable, Mapping
+
+import numpy as np
+
+META_NAME = "meta.json"
+_VERSION = 1
+
+
+class StoreWriter:
+    """Append-only store writer: ``with StoreWriter(dir) as w: w.append(batch)``.
+
+    Schema (dtypes + trailing shapes) is inferred from the first appended
+    batch and enforced afterwards; ``meta.json`` is written on close so a
+    crashed writer leaves no store that looks complete.
+    """
+
+    def __init__(self, directory: str | Path):
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self._files: dict[str, object] = {}
+        self._schema: dict[str, tuple[str, tuple[int, ...]]] = {}
+        self._samples = 0
+        self._closed = False
+
+    def append(self, batch: Mapping[str, np.ndarray]) -> None:
+        if self._closed:
+            raise RuntimeError("writer already closed")
+        batch = {k: np.asarray(v) for k, v in batch.items()}
+        counts = {k: len(v) for k, v in batch.items()}
+        if len(set(counts.values())) != 1:
+            raise ValueError(f"inconsistent batch sizes: {counts}")
+        if not self._schema:
+            self._schema = {
+                k: (v.dtype.name, tuple(v.shape[1:])) for k, v in batch.items()
+            }
+            for k in batch:
+                self._files[k] = open(self.directory / f"{k}.bin", "wb")
+        if set(batch) != set(self._schema):
+            raise ValueError(
+                f"keys {sorted(batch)} != schema keys {sorted(self._schema)}"
+            )
+        for k, v in batch.items():
+            dtype, shape = self._schema[k]
+            if v.dtype.name != dtype or tuple(v.shape[1:]) != shape:
+                raise ValueError(
+                    f"key {k!r}: got {v.dtype.name}{list(v.shape[1:])}, "
+                    f"schema says {dtype}{list(shape)}"
+                )
+            self._files[k].write(np.ascontiguousarray(v).tobytes())
+        self._samples += next(iter(counts.values()))
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        for f in self._files.values():
+            f.close()
+        meta = {
+            "version": _VERSION,
+            "samples": self._samples,
+            "keys": {
+                k: {"dtype": dtype, "shape": list(shape)}
+                for k, (dtype, shape) in self._schema.items()
+            },
+        }
+        (self.directory / META_NAME).write_text(json.dumps(meta, indent=2))
+        self._closed = True
+
+    def __enter__(self) -> "StoreWriter":
+        return self
+
+    def __exit__(self, exc_type, *_):
+        if exc_type is None:
+            self.close()
+        else:  # leave no meta.json behind a failed write
+            for f in self._files.values():
+                f.close()
+            self._closed = True
+
+
+def write_store(directory: str | Path, arrays: Mapping[str, np.ndarray],
+                chunk: int = 4096) -> Path:
+    """One-shot convenience: write in-RAM arrays as a store."""
+    n = len(next(iter(arrays.values())))
+    with StoreWriter(directory) as w:
+        for lo in range(0, n, chunk):
+            w.append({k: v[lo:lo + chunk] for k, v in arrays.items()})
+    return Path(directory)
+
+
+class MemmapDataset:
+    """Dataset over a store directory: zero-copy memmaps + threaded gather.
+
+    Implements the :class:`~.dataset.Dataset` protocol; ``batch(indices)``
+    is a row gather from the page cache (native threaded memcpy when the
+    host runtime is built), so the loader's prefetch thread overlaps disk
+    I/O with device compute exactly as it does for synthetic sources.
+    """
+
+    def __init__(self, directory: str | Path):
+        self.directory = Path(directory)
+        meta_path = self.directory / META_NAME
+        if not meta_path.is_file():
+            raise FileNotFoundError(
+                f"{meta_path} not found — not a dataset store (incomplete "
+                "write? StoreWriter only writes meta.json on clean close)"
+            )
+        meta = json.loads(meta_path.read_text())
+        if meta.get("version") != _VERSION:
+            raise ValueError(f"unsupported store version {meta.get('version')}")
+        self._samples = int(meta["samples"])
+        self.arrays: dict[str, np.memmap] = {}
+        for key, spec in meta["keys"].items():
+            path = self.directory / f"{key}.bin"
+            shape = (self._samples, *spec["shape"])
+            expected = int(np.prod(shape)) * np.dtype(spec["dtype"]).itemsize
+            actual = path.stat().st_size
+            if actual != expected:
+                raise ValueError(
+                    f"{path}: {actual} bytes, meta implies {expected}"
+                )
+            self.arrays[key] = np.memmap(path, dtype=spec["dtype"],
+                                         mode="r", shape=shape)
+
+    def __len__(self) -> int:
+        return self._samples
+
+    def batch(self, indices: np.ndarray) -> dict[str, np.ndarray]:
+        from .. import native
+
+        indices = np.asarray(indices)
+        if native.available() and len(indices) >= 64:
+            return {k: native.gather_rows(v, indices)
+                    for k, v in self.arrays.items()}
+        return {k: np.asarray(v[indices]) for k, v in self.arrays.items()}
+
+
+def materialize(dataset, directory: str | Path, *, samples: int | None = None,
+                chunk: int = 1024,
+                keys: Iterable[str] | None = None) -> Path:
+    """Write any :class:`Dataset` out as a store (synthetic → disk)."""
+    n = samples if samples is not None else len(dataset)
+    n = min(n, len(dataset))
+    with StoreWriter(directory) as w:
+        for lo in range(0, n, chunk):
+            idx = np.arange(lo, min(lo + chunk, n))
+            batch = dataset.batch(idx)
+            if keys is not None:
+                batch = {k: batch[k] for k in keys}
+            w.append(batch)
+    return Path(directory)

@@ -143,12 +143,10 @@ class MlmTask(Task):
             corrupted = jnp.where(attention_mask.astype(bool), corrupted,
                                   input_ids)
 
-        variables = {"params": params, **extra_vars}
-        kwargs = {"train": train}
-        if train:
-            kwargs["rngs"] = {"dropout": dropout_rng}
-        logits = self.model.apply(variables, corrupted, attention_mask,
-                                  **kwargs)
+        logits, extra_vars = self._apply_inputs(
+            params, extra_vars, (corrupted, attention_mask), dropout_rng,
+            train,
+        )
 
         logp = jax.nn.log_softmax(logits, axis=-1)
         token_logp = jnp.take_along_axis(

@@ -11,6 +11,7 @@ from __future__ import annotations
 from typing import Callable
 
 import jax.numpy as jnp
+import numpy as np
 
 from ..config import TrainingConfig
 from ..data.dataset import Dataset
@@ -43,8 +44,21 @@ def build(name: str, config: TrainingConfig, mesh=None) -> tuple[Task, Dataset]:
             f"unknown model {name!r}; available: {available_models()}"
         ) from None
     if "mesh" in inspect.signature(factory).parameters:
-        return factory(config, mesh=mesh)
-    return factory(config)
+        task, ds = factory(config, mesh=mesh)
+    else:
+        task, ds = factory(config)
+    if config.data_dir:
+        from ..data.filestore import MemmapDataset
+
+        if not isinstance(ds, MemmapDataset):
+            # silently training on synthetic data while the user believes
+            # their store is in use would be the worst kind of success
+            raise ValueError(
+                f"--data_dir is not supported by model {name!r} (it built a "
+                f"{type(ds).__name__}); file-backed stores currently serve "
+                "the image families"
+            )
+    return task, ds
 
 
 def _dtype(config: TrainingConfig):
@@ -77,12 +91,47 @@ def _mlp_wide(config: TrainingConfig):
 
 def _image_entry(config: TrainingConfig, model_factory, image_size: int,
                  num_classes: int):
-    """Classification task + synthetic images; ``model_factory`` takes
-    ``(num_classes, dtype)`` and returns the Flax module."""
-    from ..data.dataset import SyntheticImageDataset
+    """Classification task + images; ``model_factory`` takes
+    ``(num_classes, dtype)`` and returns the Flax module. Data comes from
+    ``config.data_dir`` (memory-mapped store, the real-data rung) when set,
+    else the synthetic source; augmentation runs on device either way."""
     from .task import ClassificationTask
 
-    task = ClassificationTask(model_factory(num_classes, _dtype(config)))
+    task = ClassificationTask(model_factory(num_classes, _dtype(config)),
+                              augment=config.augment)
+    if config.data_dir:
+        from ..data.filestore import MemmapDataset
+
+        ds = MemmapDataset(config.data_dir)
+        missing = {"image", "label"} - set(ds.arrays)
+        if missing:
+            raise ValueError(
+                f"store {config.data_dir} lacks keys {sorted(missing)} "
+                f"(has {sorted(ds.arrays)})"
+            )
+        got = ds.arrays["image"].shape[1:3]
+        if got != (image_size, image_size):
+            raise ValueError(
+                f"store images are {got}, model {config.model} expects "
+                f"({image_size}, {image_size})"
+            )
+        dtype = ds.arrays["image"].dtype
+        if dtype != np.uint8:
+            # the on-device normalisation assumes [0, 255] bytes; a
+            # pre-normalised float store would collapse to ~-1.0 silently
+            raise ValueError(
+                f"store images are {dtype}, expected uint8 (normalisation "
+                "to [-1, 1] happens on device)"
+            )
+        max_label = int(ds.arrays["label"].max()) if len(ds) else 0
+        if max_label >= num_classes:
+            raise ValueError(
+                f"store labels reach {max_label}, model {config.model} has "
+                f"{num_classes} classes"
+            )
+        return task, ds
+    from ..data.dataset import SyntheticImageDataset
+
     ds = SyntheticImageDataset(
         samples=config.dataset_size, image_size=image_size,
         num_classes=num_classes, seed=config.seed,

@@ -54,8 +54,12 @@ class Task:
         """Return ``(scalar_loss, new_extra_vars, metrics)``."""
         raise NotImplementedError
 
-    # -- shared helper ----------------------------------------------------
+    # -- shared helpers ---------------------------------------------------
     def _apply(self, params, extra_vars, batch, rng, train):
+        return self._apply_inputs(params, extra_vars, self.model_inputs(batch),
+                                  rng, train)
+
+    def _apply_inputs(self, params, extra_vars, inputs, rng, train):
         variables = {"params": params, **extra_vars}
         # flax returns (out, mutated) even for mutable=[], so only request
         # mutation when there are collections to mutate
@@ -63,8 +67,7 @@ class Task:
         kwargs: dict[str, Any] = {"train": train}
         if train and rng is not None:
             kwargs["rngs"] = {"dropout": rng}
-        out = self.model.apply(variables, *self.model_inputs(batch), mutable=mutable,
-                               **kwargs)
+        out = self.model.apply(variables, *inputs, mutable=mutable, **kwargs)
         if mutable:
             preds, new_extra = out
         else:
@@ -89,14 +92,50 @@ class ClassificationTask(Task):
     """Softmax cross-entropy + accuracy over
     ``batch = {"image": uint8 NHWC, "label": int}``. Normalisation to
     [-1, 1] happens on device (uint8 over the wire: 4x less host→device
-    bandwidth than f32 — HBM/PCIe economy the reference never needed)."""
+    bandwidth than f32 — HBM/PCIe economy the reference never needed).
+
+    ``augment`` runs *on device inside the jitted step* (host CPU feeding
+    is the classic TPU input bottleneck, SURVEY.md §7 hard part (e); a
+    torch pipeline would burn host cores on per-sample transforms):
+    ``"crop-flip"`` = pad-4 random crop + horizontal flip (the standard
+    CIFAR recipe), ``"flip"`` = horizontal flip only (ImageNet-style when
+    stored images are pre-sized). Applied only when ``train=True``.
+    """
+
+    def __init__(self, model: nn.Module, augment: str = "none"):
+        super().__init__(model)
+        if augment not in ("none", "flip", "crop-flip"):
+            raise ValueError(f"unknown augment mode {augment!r}")
+        self.augment = augment
 
     def model_inputs(self, batch):
         img = batch["image"].astype(jnp.float32) / 127.5 - 1.0
         return (img,)
 
+    def _augment(self, img: jax.Array, rng: jax.Array) -> jax.Array:
+        b, h, w, c = img.shape
+        flip_rng, crop_rng = jax.random.split(rng)
+        flip = jax.random.bernoulli(flip_rng, 0.5, (b,))
+        img = jnp.where(flip[:, None, None, None], img[:, :, ::-1, :], img)
+        if self.augment == "crop-flip":
+            pad = 4
+            padded = jnp.pad(img, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+            offs = jax.random.randint(crop_rng, (b, 2), 0, 2 * pad + 1)
+            # per-sample window: vmap(dynamic_slice) lowers to one gather
+            img = jax.vmap(
+                lambda im, o: jax.lax.dynamic_slice(im, (o[0], o[1], 0),
+                                                    (h, w, c))
+            )(padded, offs)
+        return img
+
     def loss(self, params, extra_vars, batch, rng, *, train=True):
-        logits, new_extra = self._apply(params, extra_vars, batch, rng, train)
+        (img,) = self.model_inputs(batch)
+        if train and self.augment != "none" and rng is not None:
+            aug_rng, rng = jax.random.split(rng)
+            img = self._augment(img, aug_rng)
+        logits, new_extra = self._apply_inputs(
+            params, extra_vars, (img,), rng, train
+        )
         logits = logits.astype(jnp.float32)
         labels = batch["label"]
         loss = jnp.mean(

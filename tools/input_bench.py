@@ -1,0 +1,86 @@
+"""Host input-path microbenchmark: synthetic vs file-backed gather.
+
+SURVEY.md §7 hard part (e): on TPU the input pipeline (host CPU), not the
+model math, is the classic bottleneck — this tool measures the host-side
+examples/sec of each source so input-boundness can be diagnosed without
+touching a chip (compare against the step time ``StepTimer`` reports).
+
+Usage::
+
+    python tools/input_bench.py --model resnet18 --batch 256 --iters 20
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _time_batches(dataset, batch: int, iters: int, seed: int = 0) -> float:
+    rng = np.random.default_rng(seed)
+    n = len(dataset)
+    idx = [rng.integers(0, n, batch) for _ in range(iters)]
+    dataset.batch(idx[0])  # warm page cache / native threads
+    t0 = time.perf_counter()
+    for i in idx:
+        dataset.batch(i)
+    return iters * batch / (time.perf_counter() - t0)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", default="resnet18")
+    p.add_argument("--samples", type=int, default=4096)
+    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--store", default=None,
+                   help="existing store dir; default materialises a "
+                        "temporary one from the synthetic source")
+    args = p.parse_args(argv)
+
+    from pytorch_ddp_template_tpu import native
+    from pytorch_ddp_template_tpu.config import TrainingConfig
+    from pytorch_ddp_template_tpu.data.filestore import (
+        MemmapDataset,
+        materialize,
+    )
+    from pytorch_ddp_template_tpu.models import build
+
+    config = TrainingConfig(model=args.model, dataset_size=args.samples)
+    _, synth = build(args.model, config)
+    results = {
+        "native": native.available(),
+        "synthetic_ex_per_s": _time_batches(synth, args.batch, args.iters),
+    }
+
+    tmp = None
+    if args.store:
+        store_dir = args.store
+    else:
+        tmp = tempfile.TemporaryDirectory(prefix="input_bench_")
+        store_dir = tmp.name + "/store"
+        materialize(synth, store_dir, samples=args.samples)
+    filed = MemmapDataset(store_dir)
+    results["file_ex_per_s"] = _time_batches(filed, args.batch, args.iters)
+    results["file_vs_synth"] = round(
+        results["file_ex_per_s"] / results["synthetic_ex_per_s"], 3
+    )
+    for k, v in results.items():
+        if k == "file_vs_synth":
+            print(f"{k}: {v:.3f}")
+        else:
+            print(f"{k}: {v:.1f}" if isinstance(v, float) else f"{k}: {v}")
+    if tmp is not None:
+        tmp.cleanup()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,53 @@
+"""Materialise a model-zoo synthetic dataset into a memory-mapped store.
+
+The real-data rung (``--data_dir``) trains from disk; this tool fabricates
+the disk artifact so the file-backed path is exercisable without shipping
+a corpus (the reference ships none either — its data is ``torch.randn``,
+``/root/reference/dataset.py:10-11``).
+
+Usage::
+
+    python tools/make_file_dataset.py --model resnet18 --samples 50000 \
+        --out /tmp/cifar_store
+    python ddp.py --model resnet18 --data_dir /tmp/cifar_store ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", default="resnet18",
+                   help="model-zoo key whose paired dataset to materialise")
+    p.add_argument("--samples", type=int, default=10_000)
+    p.add_argument("--out", required=True)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--chunk", type=int, default=1024)
+    args = p.parse_args(argv)
+
+    from pytorch_ddp_template_tpu.config import TrainingConfig
+    from pytorch_ddp_template_tpu.data.filestore import materialize
+    from pytorch_ddp_template_tpu.models import build
+
+    config = TrainingConfig(model=args.model, dataset_size=args.samples,
+                            seed=args.seed)
+    _, dataset = build(args.model, config)
+    t0 = time.perf_counter()
+    path = materialize(dataset, args.out, samples=args.samples,
+                       chunk=args.chunk)
+    dt = time.perf_counter() - t0
+    total = sum(f.stat().st_size for f in path.glob("*.bin"))
+    print(f"wrote {args.samples} samples ({total / 1e6:.1f} MB) to {path} "
+          f"in {dt:.1f}s ({total / dt / 1e6:.0f} MB/s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
