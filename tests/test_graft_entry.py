@@ -58,6 +58,29 @@ def test_bench_main_prints_valid_json_on_cpu():
     assert payload["platform"] == "cpu"
 
 
+def test_bench_flash_mode_parity_json():
+    # interpret-mode Pallas on tiny shapes: numerics vs XLA must agree or
+    # the mode raises (and the JSON contract reports it)
+    proc = _run_bench({"BENCH_MODE": "flash"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    payload = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert payload["metric"].startswith("flash_attn_speedup")
+    assert payload["full_max_err"] < 2e-4
+    assert payload["causal_max_err"] < 2e-4
+
+
+def test_bench_scaling_mode_sweeps_submeshes():
+    proc = _run_bench({
+        "BENCH_MODE": "scaling", "BENCH_CPU_DEVICES": "4",
+        "BENCH_BATCH": "256",
+    })
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    payload = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert payload["metric"] == "scaling_efficiency_4chips"
+    assert [s["n_devices"] for s in payload["sweep"]] == [1, 2, 4]
+    assert all(s["per_chip"] > 0 for s in payload["sweep"])
+
+
 def test_bench_emits_json_line_even_on_hard_failure():
     # a nonsense batch size fails inside run_bench; the driver contract is
     # one parseable JSON line (value 0 + error), rc != 0, no bare traceback
