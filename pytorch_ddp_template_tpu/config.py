@@ -61,6 +61,8 @@ class TrainingConfig:
     adam_beta2: float = 0.999
     adam_eps: float = 1e-8
     mesh: str = "data:-1"  # mesh spec, e.g. "data:-1" or "data:4,model:2"
+    cp_impl: str = "ring"  # context-parallel engine: ring | ulysses
+    zero1: bool = False  # shard optimizer state over the data axis (ZeRO-1)
     coordinator_address: str | None = None  # jax.distributed rendezvous
     num_processes: int | None = None
     process_id: int | None = None
@@ -150,6 +152,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--adam_beta2", type=float, default=0.999)
     p.add_argument("--adam_eps", type=float, default=1e-8)
     p.add_argument("--mesh", type=str, default="data:-1")
+    p.add_argument("--cp_impl", type=str, default="ring",
+                   choices=["ring", "ulysses"],
+                   help="Context-parallel attention engine over the seq "
+                        "axis: ring (ppermute) or ulysses (all-to-all).")
+    p.add_argument("--zero1", action="store_true",
+                   help="Shard optimizer state over the data axis (ZeRO-1): "
+                        "momentum/Adam memory divided by the DP degree.")
     p.add_argument("--coordinator_address", type=str, default=None)
     p.add_argument("--num_processes", type=int, default=None)
     p.add_argument("--process_id", type=int, default=None)

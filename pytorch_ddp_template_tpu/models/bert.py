@@ -168,18 +168,23 @@ def bert_base(dtype=jnp.float32, attn_impl: str = "auto", remat: bool = False,
 
 
 def bert_long(seq_len: int = 4096, dtype=jnp.float32, mesh=None,
-              vocab_size: int = 30_522, **size_overrides) -> BertEncoder:
-    """Long-context BERT: ring attention over the ``seq`` mesh axis when
-    present (falls back to single-chip blockwise attention otherwise),
-    remat per block. The long-context capability rung (SURVEY.md §5.7
-    notes the reference has none; here it is first-class).
+              vocab_size: int = 30_522, cp_impl: str = "ring",
+              **size_overrides) -> BertEncoder:
+    """Long-context BERT: context-parallel attention over the ``seq`` mesh
+    axis when present (falls back to single-chip blockwise attention
+    otherwise), remat per block. The long-context capability rung
+    (SURVEY.md §5.7 notes the reference has none; here it is first-class).
 
+    ``cp_impl``: ``"ring"`` (ppermute kv rotation) or ``"ulysses"``
+    (all-to-all head scatter — needs heads divisible by the seq-axis size).
     ``size_overrides`` (num_layers, num_heads, ...) scale the encoder —
-    the CI-sized registry entry shares this ring-eligibility logic."""
-    ring = bool(mesh) and mesh.shape.get("seq", 1) > 1
+    the CI-sized registry entry shares this eligibility logic."""
+    if cp_impl not in ("ring", "ulysses"):
+        raise ValueError(f"unknown cp_impl {cp_impl!r}")
+    cp = bool(mesh) and mesh.shape.get("seq", 1) > 1
     return BertEncoder(vocab_size=vocab_size, max_len=seq_len, dtype=dtype,
-                       attn_impl="ring" if ring else "blockwise",
-                       mesh=mesh if ring else None, remat=True,
+                       attn_impl=cp_impl if cp else "blockwise",
+                       mesh=mesh if cp else None, remat=True,
                        **size_overrides)
 
 

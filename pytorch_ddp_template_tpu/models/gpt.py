@@ -108,13 +108,17 @@ def gpt_small(dtype=jnp.float32, attn_impl: str = "auto", remat: bool = False,
 
 
 def gpt_long(seq_len: int = 4096, dtype=jnp.float32, mesh=None,
-             vocab_size: int = 50_257, **size_overrides) -> GptDecoder:
-    """Long-context GPT: causal ring attention over the ``seq`` mesh axis
-    when present, blockwise attention otherwise; remat per block."""
-    ring = bool(mesh) and mesh.shape.get("seq", 1) > 1
+             vocab_size: int = 50_257, cp_impl: str = "ring",
+             **size_overrides) -> GptDecoder:
+    """Long-context GPT: causal context-parallel attention (``cp_impl`` =
+    ``"ring"`` or ``"ulysses"``) over the ``seq`` mesh axis when present,
+    blockwise attention otherwise; remat per block."""
+    if cp_impl not in ("ring", "ulysses"):
+        raise ValueError(f"unknown cp_impl {cp_impl!r}")
+    cp = bool(mesh) and mesh.shape.get("seq", 1) > 1
     return GptDecoder(vocab_size=vocab_size, max_len=seq_len, dtype=dtype,
-                      attn_impl="ring" if ring else "blockwise",
-                      mesh=mesh if ring else None, remat=True,
+                      attn_impl=cp_impl if cp else "blockwise",
+                      mesh=mesh if cp else None, remat=True,
                       **size_overrides)
 
 

@@ -217,7 +217,7 @@ def _bert_long(config: TrainingConfig, mesh=None):
         mesh = make_mesh(config.mesh, jax.devices())
     seq_len, vocab = 4096, 30_522
     task = MlmTask(bert_long(seq_len=seq_len, dtype=_dtype(config), mesh=mesh,
-                             vocab_size=vocab))
+                             vocab_size=vocab, cp_impl=config.cp_impl))
     # padded batches: the ring path consumes the key-padding mask natively
     ds = SyntheticTokenDataset(samples=config.dataset_size, seq_len=seq_len,
                                vocab=vocab, seed=config.seed, padded=True)
@@ -238,8 +238,9 @@ def _bert_long_tiny(config: TrainingConfig, mesh=None):
         mesh = make_mesh(config.mesh, jax.devices())
     seq_len, vocab = 512, 1024
     task = MlmTask(bert_long(seq_len=seq_len, dtype=_dtype(config), mesh=mesh,
-                             vocab_size=vocab, num_layers=2, num_heads=2,
-                             head_dim=32, mlp_dim=128))
+                             vocab_size=vocab, cp_impl=config.cp_impl,
+                             num_layers=2, num_heads=4, head_dim=16,
+                             mlp_dim=128))
     ds = SyntheticTokenDataset(samples=config.dataset_size, seq_len=seq_len,
                                vocab=vocab, seed=config.seed, padded=True)
     return task, ds
@@ -288,7 +289,8 @@ def _gpt_long(config: TrainingConfig, mesh=None):
         mesh = make_mesh(config.mesh, jax.devices())
     seq_len, vocab = 4096, 50_257
     task = CausalLmTask(gpt_long(seq_len=seq_len, dtype=_dtype(config),
-                                 mesh=mesh, vocab_size=vocab))
+                                 mesh=mesh, vocab_size=vocab,
+                                 cp_impl=config.cp_impl))
     return _token_entry(config, task, seq_len, vocab)
 
 
@@ -304,6 +306,7 @@ def _gpt_long_tiny(config: TrainingConfig, mesh=None):
         mesh = make_mesh(config.mesh, jax.devices())
     seq_len, vocab = 512, 1024
     task = CausalLmTask(gpt_long(seq_len=seq_len, dtype=_dtype(config),
-                                 mesh=mesh, vocab_size=vocab, num_layers=2,
-                                 num_heads=2, head_dim=32, mlp_dim=128))
+                                 mesh=mesh, vocab_size=vocab,
+                                 cp_impl=config.cp_impl, num_layers=2,
+                                 num_heads=4, head_dim=16, mlp_dim=128))
     return _token_entry(config, task, seq_len, vocab)

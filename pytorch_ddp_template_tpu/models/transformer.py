@@ -74,24 +74,28 @@ class MultiHeadAttention(nn.Module):
         q = proj("query")(x)
         k = proj("key")(x)
         v = proj("value")(x)
-        if self.attn_impl == "ring":
+        if self.attn_impl in ("ring", "ulysses"):
             if self.mesh is None:
-                raise ValueError("attn_impl='ring' requires mesh")
+                raise ValueError(f"attn_impl={self.attn_impl!r} requires mesh")
             kv_mask = None
             if mask is not None:
-                # key-padding masks (B, 1, 1, T) ride the ring as a (B, T)
-                # kv-validity vector rotated with its kv chunk; arbitrary
-                # (S, T) masks would need both dims sharded — unsupported
+                # key-padding masks (B, 1, 1, T) become a (B, T) kv-validity
+                # vector (rotated with its chunk on the ring path; gathered
+                # once on the ulysses path); arbitrary (S, T) masks would
+                # need both dims sharded — unsupported
                 if mask.ndim != 4 or mask.shape[1] != 1 or mask.shape[2] != 1:
                     raise ValueError(
-                        "ring attention supports key-padding masks of shape "
-                        f"(B, 1, 1, T) only; got {mask.shape}"
+                        "context-parallel attention supports key-padding "
+                        f"masks of shape (B, 1, 1, T) only; got {mask.shape}"
                     )
                 kv_mask = mask[:, 0, 0, :]
-            from ..parallel.ring import ring_attention
+            if self.attn_impl == "ring":
+                from ..parallel.ring import ring_attention as cp_attention
+            else:
+                from ..parallel.ulysses import ulysses_attention as cp_attention
 
-            out = ring_attention(q, k, v, self.mesh, causal=self.causal,
-                                 kv_mask=kv_mask)
+            out = cp_attention(q, k, v, self.mesh, causal=self.causal,
+                               kv_mask=kv_mask)
         else:
             out = attention(q, k, v, mask=mask, causal=self.causal,
                             impl=self.attn_impl)

@@ -1,9 +1,11 @@
-"""Parallelism: sharding rules (dp/tp/sp over the mesh) + ring attention.
+"""Parallelism: sharding rules (dp/tp/sp over the mesh) + context engines.
 
 The reference's parallel surface is NCCL data parallelism only
 (SURVEY.md §2b); here data parallelism is the ``data`` mesh axis, tensor
 parallelism the ``model`` axis (``sharding.py``), and sequence/context
-parallelism the ``seq`` axis with ring attention (``ring.py``).
+parallelism the ``seq`` axis with two interchangeable engines: ring
+attention (``ring.py``, n ppermute hops) and Ulysses all-to-all
+(``ulysses.py``, 2 collectives + dense local attention).
 """
 
 from .ring import ring_attention, ring_attention_local
@@ -13,7 +15,9 @@ from .sharding import (
     describe,
     logical_shardings,
     shard_tree,
+    zero1_reshard,
 )
+from .ulysses import ulysses_attention
 
 __all__ = [
     "DEFAULT_RULES",
@@ -23,4 +27,6 @@ __all__ = [
     "ring_attention",
     "ring_attention_local",
     "shard_tree",
+    "ulysses_attention",
+    "zero1_reshard",
 ]

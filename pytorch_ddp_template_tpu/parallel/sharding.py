@@ -78,6 +78,48 @@ def shard_tree(tree: Any, mesh: Mesh,
     return jax.device_put(nn.meta.unbox(tree), shardings)
 
 
+def zero1_reshard(opt_state: Any, mesh: Mesh) -> Any:
+    """ZeRO-1: shard optimizer state over the ``data`` axis.
+
+    The reference replicates optimizer state on every rank (``optim.SGD``
+    over all params, ``/root/reference/ddp.py:183``; SURVEY.md §2b marks
+    ZeRO "No"). Here each leaf already placed on the mesh (param-mirrored
+    shardings under TP) gets its first free dim that the data-axis size
+    divides additionally sharded over ``data`` — cutting momentum/Adam
+    state memory by the DP degree. Inside the jitted step GSPMD partitions
+    the optimizer update over ``data`` and inserts the all-gather of
+    updates onto the replicated params: ZeRO-1 semantics without a wire
+    protocol, the same way sharding-induced psum replaced DDP.
+
+    Leaves with no dividable free dim (scalars, odd shapes) stay as they
+    are — correctness never depends on a leaf being sharded.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    data_size = mesh.shape.get(DATA_AXIS, 1)
+    if data_size == 1:
+        return opt_state
+
+    def widen(x):
+        if not hasattr(x, "sharding") or x.ndim == 0:
+            return x
+        spec = list(getattr(x.sharding, "spec", P()))
+        spec += [None] * (x.ndim - len(spec))
+        used: set[str] = set()
+        for s in spec:
+            if s is not None:
+                used.update((s,) if isinstance(s, str) else s)
+        if DATA_AXIS in used:
+            return x
+        for i, dim in enumerate(x.shape):
+            if spec[i] is None and dim >= data_size and dim % data_size == 0:
+                spec[i] = DATA_AXIS
+                return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+        return x
+
+    return jax.tree.map(widen, opt_state)
+
+
 def describe(mesh: Mesh) -> dict[str, Any]:
     """Human-readable sharding summary for the startup log."""
     sizes = dict(mesh.shape)

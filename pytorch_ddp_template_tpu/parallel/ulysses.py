@@ -1,0 +1,110 @@
+"""Ulysses sequence parallelism: all-to-all attention over the ``seq`` axis.
+
+The second context-parallel engine next to ``parallel/ring.py`` (the
+reference has neither — SURVEY.md §5.7: "sequence length is not a
+concept"). Where ring attention rotates kv chunks around the mesh with
+``n`` ppermute hops, Ulysses (DeepSpeed-Ulysses) re-shards ONCE:
+
+    activations arrive sequence-sharded   (B, S/n, H,   D)
+    all-to-all  → head-sharded, full seq  (B, S,   H/n, D)
+    ...dense attention per shard (any local impl: XLA, blockwise, flash)
+    all-to-all  → back to sequence-sharded
+
+Trade-off vs ring: 2 all-to-alls of the qkv/out tensors instead of n
+neighbour exchanges — fewer, larger collectives (better at small n or
+when ICI all-to-all bandwidth is strong), and the *local* attention is a
+single dense call so the Pallas flash kernel applies unmodified. The cost:
+heads must divide the seq-axis size, and peak memory holds the full
+sequence per shard for the sharded heads.
+
+Masking: after the first all-to-all each shard sees the FULL key sequence,
+so a key-padding mask is just the global (B, S) mask — all-gathered over
+``seq`` (bools: negligible bytes) and applied by the local attention.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..runtime.context import SEQ_AXIS
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = False,
+    batch_axis: str | None = None,
+    kv_mask: jax.Array | None = None,
+    impl: str = "auto",
+) -> jax.Array:
+    """All-to-all sequence-parallel attention on global ``(B, S, H, D)``.
+
+    Same calling convention as :func:`~.ring.ring_attention` (globally
+    shaped arrays; sequence dim sharded over ``seq``, batch over ``data``),
+    so the two context-parallel engines are drop-in interchangeable.
+    Requires ``H % mesh.shape['seq'] == 0``.
+    """
+    from ..runtime.context import DATA_AXIS, MODEL_AXIS
+
+    sizes = mesh.shape
+    n = sizes.get(SEQ_AXIS, 1)
+    if n == 1:  # no seq axis: plain local attention
+        from ..ops.attention import attention
+
+        mask = None if kv_mask is None else kv_mask[:, None, None, :]
+        return attention(q, k, v, mask=mask, causal=causal, impl=impl)
+    heads = q.shape[2]
+    # under combined TP+SP the heads dim arrives split over `model`
+    # (parallel/sharding.py heads->model rule); keep it split through the
+    # all-to-all rather than paying a model-axis all-gather + redundant
+    # per-shard attention (mirrors ring.py's heads_axis logic)
+    model_size = sizes.get(MODEL_AXIS, 1)
+    heads_axis = (
+        MODEL_AXIS if model_size > 1 and heads % model_size == 0 else None
+    )
+    local_heads = heads // model_size if heads_axis else heads
+    if local_heads % n:
+        raise ValueError(
+            f"ulysses needs per-model-shard heads ({local_heads}) divisible "
+            f"by seq-axis size ({n}); use ring attention for this config"
+        )
+    if batch_axis is None:
+        batch_axis = DATA_AXIS if sizes.get(DATA_AXIS, 1) > 1 else None
+    spec = P(batch_axis, SEQ_AXIS, heads_axis, None)
+    mask_spec = P(batch_axis, SEQ_AXIS)
+
+    def local(q, k, v, m):
+        # (B, S/n, H, D) -> (B, S, H/n, D): scatter heads, gather seq
+        def scatter_heads(x):
+            return lax.all_to_all(x, SEQ_AXIS, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+        ql, kl, vl = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+        mask = None
+        if m is not None:
+            # every shard needs the FULL key mask once seq is gathered
+            full = lax.all_gather(m, SEQ_AXIS, axis=1, tiled=True)
+            mask = full[:, None, None, :]
+        from ..ops.attention import attention
+
+        out = attention(ql, kl, vl, mask=mask, causal=causal, impl=impl)
+        # (B, S, H/n, D) -> (B, S/n, H, D): gather heads, scatter seq
+        return lax.all_to_all(out, SEQ_AXIS, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    if kv_mask is None:
+        fn = lambda q, k, v: local(q, k, v, None)
+        return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+    fn = lambda q, k, v, m: local(q, k, v, m)
+    return shard_map(fn, mesh=mesh,
+                     in_specs=(spec, spec, spec, mask_spec),
+                     out_specs=spec, check_vma=False)(
+        q, k, v, kv_mask.astype(bool)
+    )

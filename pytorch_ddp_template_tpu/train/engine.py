@@ -246,9 +246,14 @@ class Trainer:
         # DDP-construction param broadcast (ddp.py:194-195) as a sharding —
         # replicated for plain-DDP models, split over ``model`` for
         # tensor-parallel meshes (parallel/sharding.py rules).
-        from ..parallel.sharding import shard_tree
+        from ..parallel.sharding import shard_tree, zero1_reshard
 
-        return shard_tree(state, self.ctx.mesh)
+        state = shard_tree(state, self.ctx.mesh)
+        if self.config.zero1:
+            state = state.replace(
+                opt_state=zero1_reshard(state.opt_state, self.ctx.mesh)
+            )
+        return state
 
     def restore_or_init(self) -> tuple[TrainState, int]:
         state = self.init_state()
@@ -272,7 +277,17 @@ class Trainer:
                     f"{self.config.optimizer}; pass --no_resume or a fresh "
                     "--output_dir to start over"
                 )
-            state, _ = self.ckpt.restore(want, state)
+            try:
+                state, _ = self.ckpt.restore(want, state)
+            except Exception as exc:
+                # an orbax tree/shape mismatch is opaque; name the likely
+                # cause (model geometry changed between save and resume)
+                raise ValueError(
+                    f"checkpoint at step {want or self.ckpt.latest_step()} "
+                    f"does not match the current model {self.config.model!r} "
+                    "(architecture changed since it was saved?); pass "
+                    "--no_resume or a fresh --output_dir to start over"
+                ) from exc
             return state, int(state.step)
         return state, 0
 

@@ -20,6 +20,7 @@ from pytorch_ddp_template_tpu.parallel import (
     logical_shardings,
     ring_attention,
     shard_tree,
+    ulysses_attention,
 )
 from pytorch_ddp_template_tpu.runtime import make_mesh
 from pytorch_ddp_template_tpu.runtime.context import RuntimeContext
@@ -95,6 +96,80 @@ def test_ring_attention_kv_mask_exact(causal):
         (ring_attention(q, k, v, mesh, causal=causal, kv_mask=kv_mask)
          * kv_mask[..., None, None]) ** 2)))(q)
     np.testing.assert_allclose(g_ref, g_ring, atol=3e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_exact(causal):
+    """All-to-all CP must equal dense attention exactly (heads=4 divisible
+    by seq:4), with and without a key-padding mask, fwd and grads."""
+    mesh = make_mesh("data:2,seq:4", jax.devices())
+    rng = np.random.default_rng(3)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((2, 32, 4, 16)), jnp.float32)
+        for _ in range(3)
+    )
+    ref = dot_product_attention(q, k, v, causal=causal)
+    out = jax.jit(
+        lambda q, k, v: ulysses_attention(q, k, v, mesh, causal=causal)
+    )(q, k, v)
+    np.testing.assert_allclose(ref, out, atol=2e-5)
+
+    kv_mask = jnp.arange(32)[None, :] < jnp.asarray([24, 32])[:, None]
+    ref_m = dot_product_attention(q, k, v, causal=causal,
+                                  mask=kv_mask[:, None, None, :])
+    out_m = jax.jit(
+        lambda q, k, v, m: ulysses_attention(q, k, v, mesh, causal=causal,
+                                             kv_mask=m)
+    )(q, k, v, kv_mask)
+    np.testing.assert_allclose(ref_m, out_m, atol=2e-5)
+
+    g_ref = jax.grad(lambda q: jnp.sum(
+        dot_product_attention(q, k, v, causal=causal) ** 2))(q)
+    g_uly = jax.jit(jax.grad(lambda q: jnp.sum(
+        ulysses_attention(q, k, v, mesh, causal=causal) ** 2)))(q)
+    np.testing.assert_allclose(g_ref, g_uly, atol=3e-5)
+
+
+def test_ulysses_tp_sp_keeps_heads_split():
+    """Under a data×model×seq mesh the heads dim stays split over `model`
+    through the all-to-all (no redundant per-model-shard attention)."""
+    mesh = make_mesh("data:2,model:2,seq:2", jax.devices())
+    rng = np.random.default_rng(4)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((2, 16, 4, 16)), jnp.float32)
+        for _ in range(3)
+    )
+    ref = dot_product_attention(q, k, v, causal=True)
+    out = jax.jit(
+        lambda q, k, v: ulysses_attention(q, k, v, mesh, causal=True)
+    )(q, k, v)
+    np.testing.assert_allclose(ref, out, atol=2e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    mesh = make_mesh("data:2,seq:4", jax.devices())
+    q = jnp.zeros((2, 32, 2, 16))  # 2 heads, seq axis 4
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_attention(q, q, q, mesh)
+
+
+def test_ulysses_end_to_end(tmp_path):
+    """bert-long-tiny with cp_impl=ulysses trains through the Trainer on a
+    data×seq mesh, padded batches included."""
+    from pytorch_ddp_template_tpu.train.engine import Trainer
+
+    cfg = TrainingConfig(
+        model="bert-long-tiny", mesh="data:2,seq:4", cp_impl="ulysses",
+        dataset_size=64, per_device_train_batch_size=1, max_steps=4,
+        logging_steps=0, save_steps=0, learning_rate=5e-3,
+        max_grad_norm=1.0, output_dir=str(tmp_path), resume=False,
+    )
+    mesh = make_mesh(cfg.mesh, jax.devices())
+    task, ds = build(cfg.model, cfg)
+    assert task.model.attn_impl == "ulysses"
+    trainer = Trainer(cfg, _ctx(mesh, cfg), task, ds)
+    state = trainer.train()
+    assert int(state.step) == 4
 
 
 def test_tensor_parallel_loss_matches_replicated():
@@ -202,6 +277,71 @@ def test_train_batch_size_scales_with_data_axis_only():
     )
     shard_rows = {s.data.shape[0] for s in batch.addressable_shards}
     assert shard_rows == {cfg.per_device_train_batch_size}
+
+
+def test_zero1_shards_opt_state_and_preserves_numerics(tmp_path):
+    """ZeRO-1: momentum state sharded over data; loss trajectory identical
+    to the replicated-optimizer run (the update math is unchanged — only
+    its placement)."""
+    from pytorch_ddp_template_tpu.train.engine import Trainer
+
+    def run(zero1, out):
+        cfg = TrainingConfig(
+            model="mlp-wide", optimizer="momentum", zero1=zero1,
+            dataset_size=256, per_device_train_batch_size=4, max_steps=4,
+            logging_steps=0, save_steps=0, output_dir=out, resume=False,
+            mesh="data:8", max_grad_norm=1.0, seed=11,
+        )
+        mesh = make_mesh(cfg.mesh, jax.devices())
+        task, ds = build(cfg.model, cfg)
+        trainer = Trainer(cfg, _ctx(mesh, cfg), task, ds)
+        state = trainer.restore_or_init()[0]
+        batch = next(iter(trainer.loader.epoch(0)))
+        for _ in range(4):
+            state, metrics = trainer.train_step(state, batch)
+        # specs AFTER the jitted steps: the sharding (and the memory
+        # saving) must survive GSPMD propagation, not just init
+        specs = [str(x.sharding.spec) for x in jax.tree.leaves(state.opt_state)
+                 if hasattr(x, "sharding") and x.ndim >= 1]
+        return specs, float(metrics["loss"])
+
+    specs_rep, loss_rep = run(False, str(tmp_path / "a"))
+    specs_z1, loss_z1 = run(True, str(tmp_path / "b"))
+    assert not any("data" in s for s in specs_rep)
+    assert any("data" in s for s in specs_z1), specs_z1
+    assert abs(loss_rep - loss_z1) < 1e-6, (loss_rep, loss_z1)
+
+
+def test_zero1_composes_with_tensor_parallel():
+    """On a data×model mesh, zero1 adds `data` to free dims of opt-state
+    leaves without disturbing the model-axis param mirror."""
+    from pytorch_ddp_template_tpu.parallel import zero1_reshard
+    from pytorch_ddp_template_tpu.train.engine import (
+        TrainState, make_optimizer,
+    )
+
+    cfg = TrainingConfig(model="bert-tiny", optimizer="adam",
+                         mesh="data:4,model:2", dataset_size=32)
+    mesh = make_mesh(cfg.mesh, jax.devices())
+    task, ds = build(cfg.model, cfg)
+    batch = {k: jnp.asarray(v) for k, v in ds.batch(np.arange(8)).items()}
+    params, extra = task.init(jax.random.PRNGKey(0), batch)
+    tx, _ = make_optimizer(cfg, total_steps=10)
+    state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                       extra_vars=extra, opt_state=tx.init(params),
+                       rng=jax.random.PRNGKey(1))
+    state = shard_tree(state, mesh)
+    z1 = zero1_reshard(state.opt_state, mesh)
+    specs = [str(x.sharding.spec) for x in jax.tree.leaves(z1)
+             if hasattr(x, "sharding") and x.ndim >= 1]
+    assert any("data" in s for s in specs)
+    # model-axis placement untouched where it existed
+    tp_before = sum("model" in str(x.sharding.spec)
+                    for x in jax.tree.leaves(state.opt_state)
+                    if hasattr(x, "sharding"))
+    tp_after = sum("model" in str(x.sharding.spec)
+                   for x in jax.tree.leaves(z1) if hasattr(x, "sharding"))
+    assert tp_before == tp_after > 0
 
 
 def test_describe_and_rules():
