@@ -65,6 +65,24 @@ def shard_indices(
     return indices[shard_id::num_shards]
 
 
+def shard_validity(length: int, num_shards: int, shard_id: int) -> np.ndarray:
+    """Bool array aligned with ``shard_indices(..., drop_last=False)``:
+    ``False`` where the entry is wrap-around padding (a duplicate of an
+    index another position already covers).
+
+    Invariant with :func:`shard_indices`: entry ``j`` of shard ``s`` sits at
+    position ``j * num_shards + s`` of the (permuted, then padded)
+    concatenated index array, and padding occupies positions ``>= length``
+    regardless of shuffle — so validity is a pure position property, no
+    permutation needed. Exactly-once eval coverage (every example weighted
+    1.0 across all shards together) builds on this.
+    """
+    if length <= 0:
+        raise ValueError("empty dataset")
+    per_shard = -(-length // num_shards)
+    return np.arange(per_shard) * num_shards + shard_id < length
+
+
 def epoch_batches(
     shard: np.ndarray,
     batch_size: int,
