@@ -168,16 +168,26 @@ def init_devices(max_tries: int = 6, delay_s: float = 10.0):
     raise last  # type: ignore[misc]
 
 
-def _flops_of(compiled) -> float | None:
-    """Model FLOPs of one optimizer step from XLA's own cost analysis."""
+def cost_of(compiled) -> dict:
+    """FLOPs + bytes of one executable from XLA's own cost analysis
+    (zeros when the backend exposes none — cost analysis is best-effort).
+    Shared with tools/mfu_probe.py."""
     try:
         cost = compiled.cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0] if cost else {}
-        flops = float(cost.get("flops", 0.0))
-        return flops if flops > 0 else None
-    except Exception:  # noqa: BLE001 - cost analysis is best-effort
-        return None
+        return {
+            "flops": float(cost.get("flops", 0.0)),
+            "bytes": float(cost.get("bytes accessed", 0.0)),
+        }
+    except Exception:  # noqa: BLE001
+        return {"flops": 0.0, "bytes": 0.0}
+
+
+def _flops_of(compiled) -> float | None:
+    """Model FLOPs of one optimizer step, or None when unavailable."""
+    flops = cost_of(compiled)["flops"]
+    return flops if flops > 0 else None
 
 
 def run_bench(model: str, metric: str, unit: str, baseline: float,

@@ -28,7 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..runtime.context import DATA_AXIS, SEQ_AXIS
 from .dataset import Dataset
-from .sampler import epoch_batches, shard_indices
+from .sampler import epoch_batches, shard_indices, shard_validity
 
 
 class ShardedLoader:
@@ -46,12 +46,19 @@ class ShardedLoader:
         prefetch: int = 2,
         accum_steps: int = 1,
         seq_dims: Mapping[str, int] | None = None,
+        with_validity: bool = False,
     ):
         self.dataset = dataset
         self.mesh = mesh
         self.global_batch_size = int(global_batch_size)
         self.seed = seed
         self.shuffle = shuffle
+        self.with_validity = with_validity
+        if with_validity:
+            if accum_steps != 1:
+                raise ValueError("with_validity does not combine with accum")
+            # exactly-once mode must see the ragged tail (padded, not dropped)
+            drop_last_batch = False
         self.drop_last_batch = drop_last_batch
         self.prefetch = prefetch
 
@@ -140,7 +147,14 @@ class ShardedLoader:
             n += 1
         return n
 
-    def _host_batches(self, epoch: int) -> list[np.ndarray]:
+    def _host_batches(
+        self, epoch: int
+    ) -> list[tuple[np.ndarray, np.ndarray | None]]:
+        """Per-step ``(indices, weights)`` for this host. Weights are None
+        in train mode; in ``with_validity`` (exactly-once eval) mode each
+        batch is padded to the full SPMD shape and weights are 1.0 for real
+        examples, 0.0 for shard wrap-around padding and tail padding — so
+        summed weights across all hosts and steps equal ``len(dataset)``."""
         shard = shard_indices(
             len(self.dataset),
             self._procs,
@@ -149,7 +163,26 @@ class ShardedLoader:
             epoch=epoch,
             shuffle=self.shuffle,
         )
-        return epoch_batches(shard, self._local_batch, drop_last=self.drop_last_batch)
+        if not self.with_validity:
+            return [
+                (idx, None)
+                for idx in epoch_batches(shard, self._local_batch,
+                                         drop_last=self.drop_last_batch)
+            ]
+        valid = shard_validity(len(self.dataset), self._procs, self._proc)
+        out = []
+        # chunk positions, not indices, so validity stays aligned with the
+        # (shuffled) shard entries
+        for pos in epoch_batches(np.arange(len(shard)), self._local_batch,
+                                 drop_last=False):
+            idx = shard[pos]
+            w = valid[pos].astype(np.float32)
+            short = self._local_batch - len(idx)
+            if short:  # ragged tail: pad to the full shape, weight 0
+                idx = np.concatenate([idx, np.repeat(idx[:1], short)])
+                w = np.concatenate([w, np.zeros(short, np.float32)])
+            out.append((idx, w))
+        return out
 
     def _assemble(self, local: Mapping[str, np.ndarray]) -> dict[str, jax.Array]:
         out = {}
@@ -176,9 +209,16 @@ class ShardedLoader:
         iterating would pay full host gather + H2D cost per skipped batch.
         """
         batches = self._host_batches(epoch)[start_batch:]
+
+        def _gather(idx: np.ndarray, w: np.ndarray | None) -> dict:
+            local = dict(self.dataset.batch(idx))
+            if w is not None:
+                local["__weight__"] = w
+            return self._assemble(local)
+
         if self.prefetch <= 0:
-            for idx in batches:
-                yield self._assemble(self.dataset.batch(idx))
+            for idx, w in batches:
+                yield _gather(idx, w)
             return
 
         q: queue.Queue = queue.Queue(maxsize=self.prefetch)
@@ -199,8 +239,8 @@ class ShardedLoader:
 
         def producer() -> None:
             try:
-                for idx in batches:
-                    if stop.is_set() or not _put(self._assemble(self.dataset.batch(idx))):
+                for idx, w in batches:
+                    if stop.is_set() or not _put(_gather(idx, w)):
                         return
             except Exception as exc:  # noqa: BLE001 - surface in consumer
                 _put(exc)

@@ -153,11 +153,15 @@ class MlmTask(Task):
             logp, input_ids[..., None].astype(jnp.int32), axis=-1
         )[..., 0]
         sel = selected.astype(jnp.float32)
-        denom = jnp.maximum(sel.sum(), 1.0)
-        loss = -(token_logp * sel).sum() / denom
-        acc = ((jnp.argmax(logits, -1) == input_ids).astype(jnp.float32)
-               * sel).sum() / denom
-        return loss, extra_vars, {"loss": loss, "mlm_accuracy": acc}
+        # exactly-once eval: zero out whole padded examples (loader weight)
+        sel = sel * self.example_weights(batch, sel.shape[0])[:, None]
+        hits = (jnp.argmax(logits, -1) == input_ids).astype(jnp.float32)
+        metrics = self.weighted_metrics(
+            sel.sum(), train,  # weighted selected-token count
+            loss=-(token_logp * sel).sum(),
+            mlm_accuracy=(hits * sel).sum(),
+        )
+        return metrics["loss"], extra_vars, metrics
 
 
 def bert_base(dtype=jnp.float32, attn_impl: str = "auto", remat: bool = False,

@@ -92,11 +92,15 @@ class CausalLmTask(Task):
         logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
         targets = input_ids[:, 1:].astype(jnp.int32)
         token_logp = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        loss = -jnp.mean(token_logp)
-        acc = jnp.mean(
-            (jnp.argmax(logits[:, :-1], -1) == targets).astype(jnp.float32)
+        # per-example weights (exactly-once eval) broadcast over target slots
+        w = self.example_weights(batch, token_logp.shape[0])[:, None]
+        hits = (jnp.argmax(logits[:, :-1], -1) == targets).astype(jnp.float32)
+        metrics = self.weighted_metrics(
+            w.sum() * token_logp.shape[1], train,  # weighted target tokens
+            loss=-(token_logp * w).sum(),
+            next_token_accuracy=(hits * w).sum(),
         )
-        return loss, extra_vars, {"loss": loss, "next_token_accuracy": acc}
+        return metrics["loss"], extra_vars, metrics
 
 
 def gpt_small(dtype=jnp.float32, attn_impl: str = "auto", remat: bool = False,

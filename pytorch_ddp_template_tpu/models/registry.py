@@ -144,7 +144,12 @@ def _resnet18(config: TrainingConfig):
     """ResNet-18 / CIFAR-10-shaped data (BASELINE.md ladder rung 2)."""
     from .resnet import ResNet18
 
-    factory = lambda n, dt: ResNet18(num_classes=n, dtype=dt, stem="cifar")
+    # norm_dtype follows the compute dtype: BN statistics stay f32 inside
+    # flax regardless, and bf16 normalise/ReLU traffic between convs is
+    # worth +27% step time on the HBM-bound resnet50 (tools/mfu_probe.py,
+    # bench_records/mfu_probe_tpu_r4.jsonl)
+    factory = lambda n, dt: ResNet18(num_classes=n, dtype=dt, stem="cifar",
+                                     norm_dtype=dt)
     return _image_entry(config, factory, image_size=32, num_classes=10)
 
 
@@ -153,7 +158,8 @@ def _resnet50(config: TrainingConfig):
     """ResNet-50 / ImageNet-shaped data — the BASELINE.json headline config."""
     from .resnet import ResNet50
 
-    factory = lambda n, dt: ResNet50(num_classes=n, dtype=dt, stem="imagenet")
+    factory = lambda n, dt: ResNet50(num_classes=n, dtype=dt, stem="imagenet",
+                                     norm_dtype=dt)
     return _image_entry(config, factory, image_size=224, num_classes=1000)
 
 

@@ -89,6 +89,16 @@ class ResNet(nn.Module):
     num_filters: int = 64
     dtype: jnp.dtype = jnp.float32
     stem: str = "imagenet"  # or "cifar"
+    # BatchNorm compute dtype. f32 is the conservative default; bf16 keeps
+    # the normalise/scale/ReLU traffic in 2-byte lanes between convs (the
+    # running statistics stay f32 either way via param_dtype), measured as
+    # HBM-bandwidth relief on the conv families (tools/mfu_probe.py).
+    norm_dtype: jnp.dtype = jnp.float32
+    # Rematerialise each residual block in backward: saves only block
+    # boundaries, recomputing interior activations — a bandwidth-for-flops
+    # trade that can pay on an HBM-bound step where the MXU sits 75% idle
+    # (tools/mfu_probe.py --remat measures whether it does here).
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, *, train: bool = True):
@@ -99,7 +109,8 @@ class ResNet(nn.Module):
             use_running_average=not train,
             momentum=0.9,
             epsilon=1e-5,
-            dtype=jnp.float32,  # stats in f32 even under bf16 compute
+            dtype=self.norm_dtype,
+            param_dtype=jnp.float32,
         )
         act = nn.relu
 
@@ -116,10 +127,11 @@ class ResNet(nn.Module):
         else:
             raise ValueError(f"unknown stem {self.stem!r}")
 
+        block_cls = nn.remat(self.block_cls) if self.remat else self.block_cls
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
-                x = self.block_cls(
+                x = block_cls(
                     filters=self.num_filters * 2**i,
                     conv=conv,
                     norm=norm,

@@ -55,6 +55,36 @@ class Task:
         raise NotImplementedError
 
     # -- shared helpers ---------------------------------------------------
+    @staticmethod
+    def example_weights(batch: Batch, n: int) -> jax.Array:
+        """Per-example weights for exactly-once eval.
+
+        ``ShardedLoader(with_validity=True)`` attaches ``__weight__`` — 1.0
+        for real examples, 0.0 for SPMD shape padding (shard wrap-around and
+        ragged-tail fill; the reference's eval is a stub, ``ddp.py:123-124``,
+        and its DistributedSampler double-counts the wrap-around). Absent
+        (the train path), every example weighs 1.0, and the weighted forms
+        below reduce to plain means.
+        """
+        w = batch.get("__weight__")
+        if w is None:
+            return jnp.ones((n,), jnp.float32)
+        return w.astype(jnp.float32)
+
+    @staticmethod
+    def weighted_metrics(wsum: jax.Array, train: bool,
+                         **sums: jax.Array) -> dict[str, jax.Array]:
+        """Turn weighted metric *sums* into means, attaching the eval
+        denominator. This is the single home of the ``__denom__`` contract
+        with ``Trainer.evaluate``: each metric is ``sum / max(wsum, 1)``,
+        and in eval mode the unclamped ``wsum`` rides along so the trainer
+        can aggregate ``sum(metric*denom)/sum(denom)`` exactly."""
+        denom = jnp.maximum(wsum, 1.0)
+        metrics = {k: v / denom for k, v in sums.items()}
+        if not train:
+            metrics["__denom__"] = wsum
+        return metrics
+
     def _apply(self, params, extra_vars, batch, rng, train):
         return self._apply_inputs(params, extra_vars, self.model_inputs(batch),
                                   rng, train)
@@ -84,8 +114,12 @@ class RegressionTask(Task):
 
     def loss(self, params, extra_vars, batch, rng, *, train=True):
         preds, new_extra = self._apply(params, extra_vars, batch, rng, train)
-        loss = jnp.mean(jnp.square(preds.astype(jnp.float32) - batch["y"]))
-        return loss, new_extra, {"loss": loss}
+        err = jnp.square(preds.astype(jnp.float32) - batch["y"])
+        per_example = err.reshape(err.shape[0], -1).mean(axis=1)
+        w = self.example_weights(batch, per_example.shape[0])
+        metrics = self.weighted_metrics(w.sum(), train,
+                                        loss=(per_example * w).sum())
+        return metrics["loss"], new_extra, metrics
 
 
 class ClassificationTask(Task):
@@ -138,8 +172,10 @@ class ClassificationTask(Task):
         )
         logits = logits.astype(jnp.float32)
         labels = batch["label"]
-        loss = jnp.mean(
-            -jax.nn.log_softmax(logits)[jnp.arange(logits.shape[0]), labels]
-        )
-        acc = jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
-        return loss, new_extra, {"loss": loss, "accuracy": acc}
+        ce = -jax.nn.log_softmax(logits)[jnp.arange(logits.shape[0]), labels]
+        correct = (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+        w = self.example_weights(batch, logits.shape[0])
+        metrics = self.weighted_metrics(w.sum(), train,
+                                        loss=(ce * w).sum(),
+                                        accuracy=(correct * w).sum())
+        return metrics["loss"], new_extra, metrics

@@ -293,20 +293,33 @@ class Trainer:
 
     # -- loops ------------------------------------------------------------
     def evaluate(self, state: TrainState) -> dict[str, float]:
+        """Exactly-once eval: every held-out example contributes exactly
+        once, globally. The loader pads the ragged tail and the shard
+        wrap-around to SPMD-required shapes with weight-0 examples
+        (``with_validity``); each batch metric is a weighted mean whose
+        denominator the task reports as ``__denom__``, so the cross-batch
+        aggregate ``sum(metric*denom)/sum(denom)`` is the exact whole-set
+        statistic. (The reference's ``evaluate`` is a stub,
+        ``/root/reference/ddp.py:123-124``.)"""
         if self.eval_dataset is None:
             return {}
         loader = ShardedLoader(
             self.eval_dataset, self.ctx.mesh, self.config.train_batch_size,
-            seed=0, shuffle=False,
+            seed=0, shuffle=False, with_validity=True,
             seq_dims=getattr(self.task, "seq_dims", None),
         )
+        # accumulate on device: float() here would fence the dispatch
+        # pipeline once per batch
         totals: dict[str, Any] = {}
-        n = 0
+        denom = None
         for batch in loader.epoch(0):
-            m = self.eval_step(state, batch)
-            totals = {k: totals.get(k, 0.0) + v for k, v in m.items()} if totals else dict(m)
-            n += 1
-        return {f"eval_{k}": float(v) / max(n, 1) for k, v in totals.items()}
+            m = dict(self.eval_step(state, batch))
+            d = m.pop("__denom__")
+            for k, v in m.items():
+                totals[k] = totals.get(k, 0.0) + v * d
+            denom = d if denom is None else denom + d
+        den = max(float(denom), 1.0) if denom is not None else 1.0
+        return {f"eval_{k}": float(v) / den for k, v in totals.items()}
 
     def train(self) -> TrainState:
         cfg = self.config

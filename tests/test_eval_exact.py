@@ -1,0 +1,180 @@
+"""Exactly-once eval coverage (VERDICT.md round-3 missing #5).
+
+The reference's ``evaluate`` is a stub (``/root/reference/ddp.py:123-124``)
+and its ``DistributedSampler`` double-counts wrap-around padding; here every
+held-out example must contribute to eval metrics exactly once, globally,
+even when the holdout size divides neither the process count nor the global
+batch. The mechanism: ``shard_validity`` marks wrap-around padding,
+``ShardedLoader(with_validity=True)`` pads the ragged tail with weight-0
+examples, tasks compute weighted metrics + a ``__denom__``, and
+``Trainer.evaluate`` aggregates ``sum(metric*denom)/sum(denom)``.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_ddp_template_tpu.config import TrainingConfig
+from pytorch_ddp_template_tpu.data import SyntheticRegressionDataset
+from pytorch_ddp_template_tpu.data.loader import ShardedLoader
+from pytorch_ddp_template_tpu.data.sampler import shard_indices, shard_validity
+from pytorch_ddp_template_tpu.models import build
+from pytorch_ddp_template_tpu.runtime import init, make_mesh
+from pytorch_ddp_template_tpu.train import Trainer
+
+
+class TestShardValidity:
+    def test_valid_entries_cover_each_index_exactly_once(self):
+        length, shards = 103, 4
+        seen: list[int] = []
+        for s in range(shards):
+            idx = shard_indices(length, shards, s, seed=1, epoch=2, shuffle=True)
+            val = shard_validity(length, shards, s)
+            assert len(idx) == len(val)
+            seen.extend(int(i) for i in idx[val])
+        assert sorted(seen) == list(range(length))
+
+    def test_no_padding_when_length_divides(self):
+        for s in range(4):
+            assert shard_validity(100, 4, s).all()
+
+
+class TestLoaderValidity:
+    def test_batches_full_shape_weights_count_dataset(self):
+        ds = SyntheticRegressionDataset(103, seed=0)
+        mesh = make_mesh("data:8", jax.devices())
+        loader = ShardedLoader(ds, mesh, 16, shuffle=True, with_validity=True)
+        batches = loader._host_batches(0)
+        assert len(batches) == loader.steps_per_epoch
+        assert all(len(i) == 16 and len(w) == 16 for i, w in batches)
+        idx_all = np.concatenate([i for i, _ in batches])
+        w_all = np.concatenate([w for _, w in batches])
+        assert w_all.sum() == 103
+        # weight-1 entries cover the dataset exactly once
+        assert sorted(idx_all[w_all == 1.0]) == list(range(103))
+
+    def test_assembled_batch_carries_weight_array(self):
+        ds = SyntheticRegressionDataset(40, seed=0)
+        mesh = make_mesh("data:8", jax.devices())
+        loader = ShardedLoader(ds, mesh, 16, shuffle=False, with_validity=True)
+        batches = list(loader.epoch(0))
+        assert len(batches) == 3  # ceil(40/16), tail padded not dropped
+        for b in batches:
+            assert b["__weight__"].shape == (16,)
+        total = sum(float(jnp.sum(b["__weight__"])) for b in batches)
+        assert total == 40.0
+
+    def test_validity_rejects_accum(self):
+        ds = SyntheticRegressionDataset(64, seed=0)
+        mesh = make_mesh("data:8", jax.devices())
+        with pytest.raises(ValueError, match="accum"):
+            ShardedLoader(ds, mesh, 16, with_validity=True, accum_steps=2)
+
+
+class TestWeightedTaskLoss:
+    """Weight-0 examples must not influence any metric: replace a weighted-
+    out example with garbage and nothing may change."""
+
+    def _assert_invariant(self, task, batch_a, batch_b, w):
+        la, _, ma = task.loss(*self._args(task, batch_a, w), train=False)
+        lb, _, mb = task.loss(*self._args(task, batch_b, w), train=False)
+        assert float(la) == pytest.approx(float(lb), rel=1e-6)
+        for k in ma:
+            assert float(ma[k]) == pytest.approx(float(mb[k]), rel=1e-6), k
+
+    @staticmethod
+    def _args(task, batch, w):
+        params = batch.pop("__params__")
+        batch = dict(batch)
+        batch["__weight__"] = w
+        return (params, {}, batch, None)
+
+    def test_classification(self):
+        class PoolClassifier(nn.Module):
+            @nn.compact
+            def __call__(self, x, *, train=True):
+                return nn.Dense(7)(x.mean(axis=(1, 2)))
+
+        from pytorch_ddp_template_tpu.models.task import ClassificationTask
+
+        task = ClassificationTask(PoolClassifier())
+        rng = np.random.default_rng(0)
+        img = rng.integers(0, 256, (4, 8, 8, 3), dtype=np.uint8)
+        lab = rng.integers(0, 7, (4,))
+        params, _ = task.init(jax.random.PRNGKey(0),
+                              {"image": jnp.asarray(img), "label": jnp.asarray(lab)})
+        garbage = img.copy()
+        garbage[3] = 255 - garbage[3]
+        w = jnp.asarray([1.0, 1.0, 1.0, 0.0])
+        a = {"image": jnp.asarray(img), "label": jnp.asarray(lab),
+             "__params__": params}
+        b = {"image": jnp.asarray(garbage), "label": jnp.asarray(lab),
+             "__params__": params}
+        self._assert_invariant(task, a, b, w)
+
+    def test_mlm(self):
+        from pytorch_ddp_template_tpu.models.bert import MlmTask, bert_tiny
+
+        task = MlmTask(bert_tiny(seq_len=16, vocab_size=256))
+        rng = np.random.default_rng(1)
+        ids = rng.integers(0, 256, (4, 16))
+        params, _ = task.init(jax.random.PRNGKey(0),
+                              {"input_ids": jnp.asarray(ids)})
+        garbage = ids.copy()
+        garbage[3] = (garbage[3] + 17) % 256
+        w = jnp.asarray([1.0, 1.0, 1.0, 0.0])
+        a = {"input_ids": jnp.asarray(ids), "__params__": params}
+        b = {"input_ids": jnp.asarray(garbage), "__params__": params}
+        self._assert_invariant(task, a, b, w)
+
+    def test_causal_lm(self):
+        from pytorch_ddp_template_tpu.models.gpt import CausalLmTask, gpt_tiny
+
+        task = CausalLmTask(gpt_tiny(seq_len=16, vocab_size=64))
+        rng = np.random.default_rng(2)
+        ids = rng.integers(0, 64, (4, 16))
+        params, _ = task.init(jax.random.PRNGKey(0),
+                              {"input_ids": jnp.asarray(ids)})
+        garbage = ids.copy()
+        garbage[3] = (garbage[3] + 29) % 64
+        w = jnp.asarray([1.0, 1.0, 1.0, 0.0])
+        a = {"input_ids": jnp.asarray(ids), "__params__": params}
+        b = {"input_ids": jnp.asarray(garbage), "__params__": params}
+        self._assert_invariant(task, a, b, w)
+
+
+class TestEvaluateExact:
+    def _trainer(self, tmp_path, eval_size):
+        cfg = TrainingConfig(
+            output_dir=str(tmp_path / "o"), max_steps=2,
+            per_device_train_batch_size=4, dataset_size=256,
+            logging_steps=0, save_steps=0,
+        )
+        ctx = init(cfg)
+        task, ds = build("mlp", cfg)
+        eval_ds = SyntheticRegressionDataset(eval_size, seed=7)
+        return Trainer(cfg, ctx, task, ds, eval_dataset=eval_ds), task, eval_ds
+
+    def test_matches_whole_set_statistic(self, tmp_path):
+        # 103 examples, global batch 32: neither divides — the hard case
+        t, task, eval_ds = self._trainer(tmp_path, 103)
+        state, _ = t.restore_or_init()
+        ev = t.evaluate(state)
+
+        whole = eval_ds.batch(np.arange(103))
+        params = jax.device_get(state.params)
+        loss, _, _ = task.loss(params, {}, jax.tree.map(jnp.asarray, dict(whole)),
+                               None, train=False)
+        assert ev["eval_loss"] == pytest.approx(float(loss), rel=1e-5)
+
+    def test_holdout_smaller_than_one_batch(self, tmp_path):
+        t, task, eval_ds = self._trainer(tmp_path, 10)
+        state, _ = t.restore_or_init()
+        ev = t.evaluate(state)
+        whole = eval_ds.batch(np.arange(10))
+        params = jax.device_get(state.params)
+        loss, _, _ = task.loss(params, {}, jax.tree.map(jnp.asarray, dict(whole)),
+                               None, train=False)
+        assert ev["eval_loss"] == pytest.approx(float(loss), rel=1e-5)

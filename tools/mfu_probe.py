@@ -1,0 +1,171 @@
+"""MFU probe: where does the train step's time go, per XLA's own numbers?
+
+Decomposes one benchmark config into forward-only / forward+backward /
+full-optimizer-step executables, timing each and reporting XLA cost
+analysis (flops, bytes accessed → arithmetic intensity), so MFU tuning is
+driven by measurement rather than guesses (VERDICT.md round-3 weak #2: the
+resnet50 MFU of 0.249 had never been decomposed).
+
+Usage (TPU or CPU):
+    python tools/mfu_probe.py --model resnet50 --batch 256
+    python tools/mfu_probe.py --model resnet50 --batch 256 --norm-dtype bf16
+
+Emits one JSON line per measurement, suitable for bench_records/.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def timed(fn, *args, iters: int = 20, warmup: int = 3) -> float:
+    """Time ``fn`` (which must return a scalar array). Sync is a host read
+    of that scalar: on the axon tunnel ``block_until_ready`` can return
+    before compute finishes (see bench.py), but device execution is
+    in-order, so fetching a value produced by the LAST enqueued call fences
+    the whole run."""
+    import numpy as np
+
+    for _ in range(warmup):
+        out = fn(*args)
+    float(np.asarray(out))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    float(np.asarray(out))
+    return (time.perf_counter() - t0) / iters
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50")
+    ap.add_argument("--batch", type=int, default=0, help="0 = bench default")
+    ap.add_argument("--norm-dtype", default=None, choices=[None, "f32", "bf16"],
+                    help="ResNet BatchNorm compute-dtype ablation")
+    ap.add_argument("--remat", action="store_true",
+                    help="rematerialise residual blocks (ResNet ablation)")
+    ap.add_argument("--iters", type=int, default=20)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import bench
+    from bench import cost_of
+    from pytorch_ddp_template_tpu.config import TrainingConfig
+    from pytorch_ddp_template_tpu.models import build
+    from pytorch_ddp_template_tpu.parallel import shard_tree
+    from pytorch_ddp_template_tpu.runtime import make_mesh
+    from pytorch_ddp_template_tpu.train.engine import (
+        TrainState, make_optimizer, make_train_step,
+    )
+
+    per_device = args.batch or bench.default_batch(args.model)
+    devices = jax.devices()
+    n_dev = len(devices)
+    mesh = make_mesh(f"data:{n_dev}", devices)
+    config = TrainingConfig(
+        model=args.model, mesh=f"data:{n_dev}",
+        per_device_train_batch_size=per_device, bf16=True,
+        dataset_size=per_device * n_dev * 2, warmup_steps=0,
+        max_grad_norm=1000.0,
+    )
+    task, dataset = build(args.model, config, mesh=mesh)
+    if args.norm_dtype is not None:
+        # rebuild the module with the requested BatchNorm compute dtype
+        nd = jnp.bfloat16 if args.norm_dtype == "bf16" else jnp.float32
+        task.model = task.model.clone(norm_dtype=nd)
+    if args.remat:
+        task.model = task.model.clone(remat=True)
+
+    global_batch = per_device * n_dev
+    idx = np.arange(global_batch) % len(dataset)
+    batch = {
+        k: jax.device_put(v, NamedSharding(mesh, P("data")))
+        for k, v in dataset.batch(idx).items()
+    }
+    seed_key = jax.random.PRNGKey(0)
+    params, extra = task.init(seed_key, batch)
+    tx, schedule = make_optimizer(config, total_steps=10_000)
+    state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                       extra_vars=extra, opt_state=tx.init(params),
+                       rng=jax.random.clone(seed_key))
+    state = shard_tree(state, mesh)
+
+    # three rungs: fwd-only, fwd+bwd (no update), full optimizer step
+    def fwd(params, extra_vars, batch, rng):
+        loss, _, _ = task.loss(params, extra_vars, batch, rng, train=True)
+        return loss
+
+    def fwd_bwd(params, extra_vars, batch, rng):
+        def lf(p):
+            loss, new_extra, _ = task.loss(p, extra_vars, batch, rng, train=True)
+            return loss, new_extra
+        (loss, _), grads = jax.value_and_grad(lf, has_aux=True)(params)
+        return loss, grads
+
+    rng = jax.random.fold_in(seed_key, 1)
+    fwd_c = jax.jit(fwd).lower(state.params, state.extra_vars, batch, rng).compile()
+    bwd_c = jax.jit(fwd_bwd).lower(state.params, state.extra_vars, batch, rng).compile()
+    step_c = make_train_step(task, tx, schedule, accum_steps=1).lower(
+        state, batch).compile()
+
+    kind = devices[0].device_kind
+    peak = next((v for k, v in bench.PEAK_FLOPS.items() if k in kind), None)
+    rows = []
+    t_step = None
+    for name, compiled, call in (
+        ("fwd", fwd_c, lambda: fwd_c(state.params, state.extra_vars, batch, rng)),
+        ("fwd_bwd", bwd_c,
+         lambda: bwd_c(state.params, state.extra_vars, batch, rng)[0]),
+        ("full_step", step_c, None),
+    ):
+        if name == "full_step":
+            # the step donates its input state; rethread it every call
+            holder = {"state": state}
+
+            def call(h=holder):
+                h["state"], m = step_c(h["state"], batch)
+                return m["loss"]
+
+        t = timed(call, iters=args.iters)
+        c = cost_of(compiled)
+        row = {
+            "probe": name, "model": args.model, "batch": global_batch,
+            "norm_dtype": args.norm_dtype or "f32", "remat": args.remat,
+            "time_ms": round(t * 1e3, 3),
+            "gflops": round(c["flops"] / 1e9, 2),
+            "gbytes": round(c["bytes"] / 1e9, 3),
+            "intensity_flops_per_byte": round(c["flops"] / c["bytes"], 1)
+            if c["bytes"] else None,
+            "tflops_per_sec": round(c["flops"] / t / 1e12, 2),
+            "device_kind": kind,
+        }
+        if peak:
+            row["mfu"] = round(c["flops"] / t / peak, 4)
+            # roofline: what the step time would be if HBM (~819 GB/s on
+            # v5e) or the MXU were the only limit
+            row["hbm_bound_ms"] = round(c["bytes"] / 819e9 * 1e3, 3)
+            row["mxu_bound_ms"] = round(c["flops"] / peak * 1e3, 3)
+        rows.append(row)
+        if name == "full_step":
+            t_step = t
+        print(json.dumps(row), flush=True)
+
+    imgs = global_batch / t_step
+    print(json.dumps({"probe": "throughput", "model": args.model,
+                      "norm_dtype": args.norm_dtype or "f32",
+                      "examples_per_sec_per_chip": round(imgs / n_dev, 1)}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
