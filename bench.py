@@ -49,7 +49,7 @@ PEAK_FLOPS = {
     "TPU v2": 45e12,
 }
 
-MODE = os.environ.get("BENCH_MODE", "train")  # train | scaling | flash
+MODE = os.environ.get("BENCH_MODE", "train")  # train | e2e | scaling | flash
 MODEL = os.environ.get("BENCH_MODEL", "resnet50")
 WARMUP_STEPS = int(os.environ.get("BENCH_WARMUP", "5"))
 TIMED_STEPS = int(os.environ.get("BENCH_STEPS", "30"))
@@ -99,6 +99,13 @@ def init_devices(max_tries: int = 6, delay_s: float = 10.0):
     (b) the relay process is dead — the plugin then blocks on reconnect
     *forever*, so pre-check the relay port and bound each init attempt with
     SIGALRM rather than hang to an opaque driver timeout.
+
+    SIGALRM limitation (known, accepted): Python delivers signals between
+    bytecodes, so if PJRT blocks inside a C call that never returns the
+    alarm cannot interrupt it. The relay-port pre-check above exists
+    precisely to avoid entering init in that state; the alarm bounds the
+    Python-visible init phases. A thread-bound init would not help — the
+    hung C thread cannot be killed and would poison the retry.
     """
     import signal
 
@@ -293,6 +300,93 @@ def run_bench(model: str, metric: str, unit: str, baseline: float,
     return out
 
 
+def run_e2e(model: str, metric: str, unit: str, baseline: float) -> dict:
+    """Steady-state throughput through ``Trainer`` + ``ShardedLoader`` —
+    the loader/prefetch/H2D path included, where ``run_bench`` re-feeds one
+    staged device batch (pure device compute). The reference pays its
+    dataloader every step (``/root/reference/ddp.py:216-220``); emitting
+    both numbers side by side keeps the comparison honest and quantifies
+    the input-path gap. ``BENCH_DATA_DIR`` runs the same config against a
+    memory-mapped file store instead of the synthetic source."""
+    import jax
+    import numpy as np
+
+    from pytorch_ddp_template_tpu.config import TrainingConfig
+    from pytorch_ddp_template_tpu.models import build
+    from pytorch_ddp_template_tpu.runtime import init as rt_init
+    from pytorch_ddp_template_tpu.train.engine import Trainer
+
+    per_device = PER_DEVICE_BATCH or default_batch(model)
+    n_dev = len(jax.devices())
+    total_steps = WARMUP_STEPS + TIMED_STEPS
+    global_batch = per_device * n_dev
+    # cached-batch comparison FIRST: running it after the trainer would
+    # hold two full model+optimizer replicas live at once (HBM-tight
+    # configs would OOM in the comparison that neither mode hits alone)
+    cached = run_bench(model, metric, unit, baseline)
+    config = TrainingConfig(
+        model=model,
+        mesh=f"data:{n_dev}",
+        per_device_train_batch_size=per_device,
+        bf16=True,
+        # enough data that the timed window never re-reads a cached batch
+        dataset_size=global_batch * total_steps,
+        data_dir=os.environ.get("BENCH_DATA_DIR", ""),
+        warmup_steps=0,
+        max_grad_norm=1000.0,
+        max_steps=total_steps,
+        logging_steps=0,
+        save_steps=0,
+        output_dir=os.environ.get("BENCH_OUTPUT", "/tmp/bench_e2e"),
+    )
+    ctx = rt_init(config)
+    task, dataset = build(model, config, mesh=ctx.mesh)
+    trainer = Trainer(config, ctx, task, dataset)
+    state, _ = trainer.restore_or_init()
+
+    # one timed window over the steady state, fenced ONCE at the end by a
+    # host read of the final loss (block_until_ready can lie on the axon
+    # transport, see run_bench) — per-step fencing would serialise host
+    # dispatch against device compute and misreport the pipelined rate
+    timed = 0
+    t0 = None
+    metrics = None
+    for i, batch in enumerate(trainer.loader.epoch(0)):
+        if i == WARMUP_STEPS:
+            if metrics is not None:  # drain warmup before the clock starts
+                float(metrics["loss"])
+            t0 = time.perf_counter()
+        state, metrics = trainer.train_step(state, batch)
+        if i >= WARMUP_STEPS:
+            timed += 1
+        if i + 1 >= total_steps:
+            break
+    if t0 is None or timed == 0:
+        raise RuntimeError("dataset exhausted before the timed window")
+    loss = float(metrics["loss"])
+    dt_total = time.perf_counter() - t0
+    assert np.isfinite(loss), f"non-finite loss {loss}"
+
+    dt = dt_total / timed
+    per_chip = global_batch / dt / n_dev
+    return {
+        "metric": f"{model}_e2e_ex_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": unit,
+        "vs_baseline": round(per_chip / baseline, 4),
+        "platform": jax.devices()[0].platform,
+        "n_devices": n_dev,
+        "global_batch": global_batch,
+        "step_time_ms": round(1000 * dt, 2),
+        "data_source": "filestore" if config.data_dir else "synthetic",
+        "cached_batch_per_chip": cached["value"],
+        "cached_step_time_ms": cached["step_time_ms"],
+        "input_path_overhead_pct": round(
+            100 * (cached["value"] - per_chip) / cached["value"], 2
+        ) if cached["value"] else None,
+    }
+
+
 def run_scaling(model: str) -> dict:
     """DDP scaling sweep: per-chip throughput on data:1/2/4/... sub-meshes.
 
@@ -314,11 +408,14 @@ def run_scaling(model: str) -> dict:
         n *= 2
     base = sweep[0]["per_chip"]
     eff = sweep[-1]["per_chip"] / base if base else 0.0
+    degenerate = len(sweep) == 1  # n=1 "scaling" proves nothing
     return {
         "metric": f"scaling_efficiency_{sweep[-1]['n_devices']}chips",
         "value": round(eff, 4),
         "unit": "ratio",
-        "vs_baseline": round(eff / 0.9, 4),  # BASELINE ≥90% target
+        # a 1-chip sweep must not masquerade as a ≥90%-target pass
+        "vs_baseline": 0.0 if degenerate else round(eff / 0.9, 4),
+        "degenerate": degenerate,
         "model": model,
         "sweep": sweep,
     }
@@ -407,12 +504,16 @@ def main() -> None:
             _emit(run_scaling(model))
         elif MODE == "flash":
             _emit(run_flash())
+        elif MODE == "e2e":
+            _emit(run_e2e(model, metric, unit, baseline))
         elif MODE == "train":
             _emit(run_bench(model, metric, unit, baseline))
         else:  # typo'd mode must not masquerade as a train number
             raise ValueError(
-                f"unknown BENCH_MODE {MODE!r}; expected train|scaling|flash"
+                f"unknown BENCH_MODE {MODE!r}; expected train|e2e|scaling|flash"
             )
+    except KeyboardInterrupt:  # operator abort is not a value-0 datum
+        raise
     except BaseException as e:  # noqa: BLE001 - JSON-or-bust driver contract
         _fail(metric, unit, e)
         sys.exit(1)

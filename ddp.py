@@ -65,7 +65,11 @@ def main(argv: list[str] | None = None) -> int:
     try:
         task, dataset = build(config.model, config)
         eval_ds = None
-        if config.eval_steps:
+        if config.eval_data_dir:
+            # a dedicated held-out store (e.g. the CIFAR-10 test split)
+            # beats a tail holdout of the training store
+            eval_ds = MemmapDataset(config.eval_data_dir)
+        elif config.eval_steps:
             dataset, eval_ds = train_eval_split(config, dataset)
         trainer = Trainer(config, ctx, task, dataset, eval_dataset=eval_ds)
         state = trainer.train()

@@ -69,6 +69,8 @@ class TrainingConfig:
     model: str = "mlp"  # model-zoo key (models/registry.py)
     dataset_size: int = 100_000  # reference: FooDataset(100000) at ddp.py:135
     data_dir: str | None = None  # file-backed store (data/filestore.py); None = synthetic
+    eval_data_dir: str | None = None  # held-out store (e.g. the CIFAR-10 test
+    #                                   split); None = tail-holdout of data_dir
     augment: str = "none"  # on-device augmentation: none | flip | crop-flip
     eval_steps: int = 0  # 0 disables; reference evaluate() is a stub (ddp.py:123-124)
     resume: bool = True  # auto-resume from latest checkpoint in output_dir
@@ -167,6 +169,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--data_dir", type=str, default=None,
                    help="Train from a memory-mapped array store instead of "
                         "synthetic data (see data/filestore.py).")
+    p.add_argument("--eval_data_dir", type=str, default=None,
+                   help="Evaluate on this store (e.g. the CIFAR-10 test "
+                        "split) instead of a tail holdout of --data_dir.")
     p.add_argument("--augment", type=str, default="none",
                    choices=["none", "flip", "crop-flip"],
                    help="On-device image augmentation inside the jitted step.")

@@ -153,7 +153,11 @@ class ClassificationTask(Task):
         img = jnp.where(flip[:, None, None, None], img[:, :, ::-1, :], img)
         if self.augment == "crop-flip":
             pad = 4
-            padded = jnp.pad(img, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+            # images here are already normalised to [-1, 1]; the standard
+            # recipe (torchvision RandomCrop) pads the RAW image with 0 =
+            # black, which is -1.0 post-normalisation — not 0.0 (mid-gray)
+            padded = jnp.pad(img, ((0, 0), (pad, pad), (pad, pad), (0, 0)),
+                             constant_values=-1.0)
             offs = jax.random.randint(crop_rng, (b, 2), 0, 2 * pad + 1)
             # per-sample window: vmap(dynamic_slice) lowers to one gather
             img = jax.vmap(

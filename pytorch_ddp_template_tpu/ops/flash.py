@@ -218,6 +218,16 @@ def flash_attention(
     # 128-multiple seq_len works (e.g. seq 768, block 512 -> 256)
     block_q = math.gcd(q.shape[1], block_size)
     block_kv = math.gcd(k.shape[1], block_size)
+    if not interpret and min(block_q, block_kv) < 128:
+        # a seq that only fits a sub-128 block would compile to pathological
+        # Mosaic tiles (128 is the TPU lane width) — fail with intent
+        # instead of silently degrading
+        raise ValueError(
+            f"flash_attention: seq lengths ({q.shape[1]}, {k.shape[1]}) with "
+            f"block_size {block_size} fit only a {min(block_q, block_kv)}-"
+            "wide block (< 128, the TPU lane width); pad the sequence to a "
+            "multiple of 128 or use impl='xla'/'blockwise'"
+        )
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
     out = _flash(qt, kt, vt, causal, block_q, block_kv, interpret)
     return out.transpose(0, 2, 1, 3)

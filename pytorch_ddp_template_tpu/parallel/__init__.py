@@ -5,9 +5,12 @@ The reference's parallel surface is NCCL data parallelism only
 parallelism the ``model`` axis (``sharding.py``), and sequence/context
 parallelism the ``seq`` axis with two interchangeable engines: ring
 attention (``ring.py``, n ppermute hops) and Ulysses all-to-all
-(``ulysses.py``, 2 collectives + dense local attention).
+(``ulysses.py``, 2 collectives + dense local attention). Pipeline
+parallelism gets a minimal GPipe mechanism over the ``pipe`` axis
+(``pipeline.py``).
 """
 
+from .pipeline import pipeline_apply, stack_stage_params
 from .ring import ring_attention, ring_attention_local
 from .sharding import (
     DEFAULT_RULES,
@@ -24,8 +27,10 @@ __all__ = [
     "active_rules",
     "describe",
     "logical_shardings",
+    "pipeline_apply",
     "ring_attention",
     "ring_attention_local",
+    "stack_stage_params",
     "shard_tree",
     "ulysses_attention",
     "zero1_reshard",

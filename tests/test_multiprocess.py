@@ -1,0 +1,88 @@
+"""Two-process distributed rehearsal (VERDICT.md round-3 missing #3).
+
+The reference's *primary* mode is multi-process (``torch.distributed.launch``
+spawning ranks, ``/root/reference/ddp.py:103``); everything else in this
+suite runs ``jax.process_count() == 1``. Here two real processes (4 virtual
+CPU devices each) rendezvous through ``jax.distributed.initialize`` and run
+the full stack: sharded loading, SPMD train steps over the cross-process
+mesh, divergence detection of an injected param flip, and an orbax
+multi-host checkpoint round-trip. See ``two_process_worker.py`` for what
+each worker runs.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+
+WORKER = Path(__file__).resolve().parent / "two_process_worker.py"
+REPO = WORKER.parent.parent
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_rehearsal(tmp_path):
+    coord = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYTHONPATH"] = str(REPO)
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(WORKER), str(i), coord, str(tmp_path)],
+            env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+
+    results = {}
+    for i in range(2):
+        path = tmp_path / f"result_{i}.json"
+        assert path.is_file(), f"worker {i} wrote no result"
+        results[i] = json.loads(path.read_text())
+
+    for r in results.values():
+        # the distributed context was real, not degenerate
+        assert r["process_count"] == 2
+        assert r["local_devices"] == 4
+        assert r["global_devices"] == 8
+        assert np.isfinite(r["loss"])
+        # replicated state agreed; the injected flip was caught
+        assert r["divergence_clean"] is True
+        assert r["divergence_flagged"] is True
+        # orbax round-trip restored bit-identical params at the right step
+        assert r["ckpt_roundtrip"] is True
+        assert r["ckpt_step"] == 2
+
+    # SPMD: both processes computed the identical replicated loss
+    assert results[0]["loss"] == results[1]["loss"]
+
+    # DistributedSampler semantics across real processes: disjoint shards
+    # covering the dataset (100 examples, batch 16: 96 drawn, no overlap)
+    a = set(results[0]["loader_indices"])
+    b = set(results[1]["loader_indices"])
+    assert len(results[0]["loader_indices"]) == len(a) == 48
+    assert len(results[1]["loader_indices"]) == len(b) == 48
+    assert not a & b
+    assert a | b <= set(range(100))

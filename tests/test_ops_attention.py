@@ -85,3 +85,43 @@ def test_fully_masked_rows_zero_not_nan():
     out = blockwise_attention(q, k, v, mask=mask, block_size=4)
     assert not bool(jnp.isnan(out).any())
     np.testing.assert_allclose(out, jnp.zeros_like(out), atol=1e-6)
+
+
+class TestFlashDispatch:
+    """Auto-dispatch policy + explicit-path input validation
+    (VERDICT.md round-3 weak #4)."""
+
+    def test_degraded_block_raises_on_tpu_path(self, qkv):
+        # seq 1000: gcd(1000, 512) = 8 — a pathological Mosaic tile; the
+        # compiled (non-interpret) path must refuse, not degrade
+        rng = np.random.default_rng(1)
+        q, k, v = (
+            jnp.asarray(rng.standard_normal((1, 1000, 2, 64)), jnp.float32)
+            for _ in range(3)
+        )
+        with pytest.raises(ValueError, match="128"):
+            flash_attention(q, k, v, interpret=False)
+
+    def test_interpret_mode_small_blocks_still_allowed(self, qkv):
+        # CI shapes run sub-128 blocks in the CPU interpreter by design
+        q, k, v = qkv
+        out = flash_attention(q, k, v, block_size=16)
+        ref = dot_product_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_auto_threshold_follows_measurements(self, monkeypatch):
+        from pytorch_ddp_template_tpu.ops import attention as A
+
+        monkeypatch.setattr(A.jax, "default_backend", lambda: "tpu")
+        short = jnp.zeros((1, 512, 8, 64))
+        long = jnp.zeros((1, 1024, 8, 64))
+        odd = jnp.zeros((1, 1000, 8, 64))
+        cross_kv = jnp.zeros((1, 250, 8, 64))
+        assert A._pick_impl("auto", short, short) == "xla"  # unmeasured
+        assert A._pick_impl("auto", long, long) == "flash"  # recorded win
+        assert A._pick_impl("auto", odd, odd) == "xla"  # unaligned seq
+        # cross-attention with a kv length the kernel would refuse: auto
+        # must route to XLA, not pick a path that raises
+        assert A._pick_impl("auto", long, cross_kv) == "xla"
+        assert A._pick_impl("flash", short, short) == "flash"  # explicit

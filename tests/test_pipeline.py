@@ -1,0 +1,72 @@
+"""Pipeline-parallel mechanism proof (VERDICT.md round-3 weak #7: give
+``PIPE_AXIS`` a mechanism or delete it). The GPipe fill/drain schedule over
+``ppermute`` must reproduce plain sequential stage application exactly."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_ddp_template_tpu.parallel.pipeline import (
+    pipeline_apply,
+    stack_stage_params,
+)
+from pytorch_ddp_template_tpu.runtime import make_mesh
+
+
+def stage_fn(w, x):
+    return jnp.tanh(x @ w["kernel"] + w["bias"])
+
+
+def make_stage(rng, d):
+    kw, kb = jax.random.split(rng)
+    return {"kernel": jax.random.normal(kw, (d, d)) * 0.5,
+            "bias": jax.random.normal(kb, (d,)) * 0.1}
+
+
+@pytest.mark.parametrize("n_stages,n_micro", [(2, 4), (4, 3), (2, 1)])
+def test_pipeline_matches_sequential(n_stages, n_micro):
+    d, mb = 8, 4
+    mesh = make_mesh(f"pipe:{n_stages}", jax.devices()[:n_stages])
+    rngs = jax.random.split(jax.random.PRNGKey(0), n_stages + 1)
+    stages = [make_stage(rngs[i], d) for i in range(n_stages)]
+    x = jax.random.normal(rngs[-1], (n_micro, mb, d))
+
+    params = stack_stage_params(stages, mesh)
+    got = pipeline_apply(params, stage_fn, x, mesh)
+
+    want = x
+    for w in stages:
+        want = jax.vmap(lambda xb, w=w: stage_fn(w, xb))(want)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_composes_with_data_axis():
+    """pipe:2 alongside a data axis: the pipeline runs per data shard."""
+    d, mb, n_micro = 8, 4, 2
+    mesh = make_mesh("data:2,pipe:2", jax.devices()[:4])
+    rngs = jax.random.split(jax.random.PRNGKey(1), 3)
+    stages = [make_stage(rngs[i], d) for i in range(2)]
+    x = jax.random.normal(rngs[-1], (n_micro, mb, d))
+
+    params = stack_stage_params(stages, mesh)
+    got = pipeline_apply(params, stage_fn, x, mesh)
+    want = x
+    for w in stages:
+        want = jax.vmap(lambda xb, w=w: stage_fn(w, xb))(want)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_stage_count_mismatch_refused():
+    """4 stacked stages on a pipe:2 mesh would silently drop stages 1 and 3
+    (each rank slices [0] of its 2-stage shard) — must raise instead."""
+    d = 8
+    mesh = make_mesh("pipe:2", jax.devices()[:2])
+    rngs = jax.random.split(jax.random.PRNGKey(2), 5)
+    stages = [make_stage(rngs[i], d) for i in range(4)]
+    params = jax.tree.map(lambda *xs: jnp.stack(xs), *stages)
+    x = jax.random.normal(rngs[-1], (2, 4, d))
+    with pytest.raises(ValueError, match="pipe axis"):
+        pipeline_apply(params, stage_fn, x, mesh)

@@ -1,0 +1,117 @@
+"""Worker process for the two-process distributed rehearsal.
+
+Launched (twice) by ``test_multiprocess.py``. Everything the single-process
+suite can only fake runs for real here: ``jax.distributed.initialize``
+rendezvous (the reference's ``init_process_group``, ``/root/reference/
+ddp.py:103``), the init-time native-RNG agreement allgather, per-process
+loader sharding feeding ``make_array_from_process_local_data``, SPMD train
+steps over a cross-process mesh, cross-host divergence detection, and an
+orbax multi-host save/restore round-trip.
+
+Writes ``result_<proc>.json`` into the work dir; exit code 0 iff all
+stages ran.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from pathlib import Path
+
+
+def main() -> int:
+    proc_id, coord, workdir = int(sys.argv[1]), sys.argv[2], Path(sys.argv[3])
+    repo = Path(__file__).resolve().parent.parent
+    sys.path.insert(0, str(repo))
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_ddp_template_tpu.checkpoint.manager import CheckpointManager
+    from pytorch_ddp_template_tpu.config import TrainingConfig
+    from pytorch_ddp_template_tpu.data import SyntheticRegressionDataset
+    from pytorch_ddp_template_tpu.data.loader import ShardedLoader
+    from pytorch_ddp_template_tpu.models import build
+    from pytorch_ddp_template_tpu.parallel import shard_tree
+    from pytorch_ddp_template_tpu.runtime import init, shutdown
+    from pytorch_ddp_template_tpu.train.engine import (
+        TrainState,
+        make_optimizer,
+        make_train_step,
+    )
+    from pytorch_ddp_template_tpu.utils import divergence
+
+    result: dict = {"proc": proc_id}
+
+    cfg = TrainingConfig(
+        cpu=True,
+        coordinator_address=coord,
+        num_processes=2,
+        process_id=proc_id,
+        mesh="data:8",
+        per_device_train_batch_size=2,
+        dataset_size=256,
+        output_dir=str(workdir / "ckpt"),
+        warmup_steps=0,
+    )
+    ctx = init(cfg)  # exercises rendezvous + native-RNG agreement allgather
+    result["process_count"] = jax.process_count()
+    result["local_devices"] = jax.local_device_count()
+    result["global_devices"] = jax.device_count()
+
+    # -- loader: per-process disjoint cover --------------------------------
+    ds = SyntheticRegressionDataset(100, seed=0)
+    loader = ShardedLoader(ds, ctx.mesh, 16, seed=5, shuffle=True)
+    idx = np.concatenate([i for i, _ in loader._host_batches(0)])
+    result["loader_indices"] = [int(i) for i in idx]
+
+    # -- SPMD train steps over the cross-process mesh ----------------------
+    task, train_ds = build("mlp", cfg)
+    train_loader = ShardedLoader(train_ds, ctx.mesh, cfg.train_batch_size,
+                                 seed=cfg.seed)
+    tx, schedule = make_optimizer(cfg, total_steps=100)
+    batches = iter(train_loader.epoch(0))
+    first = next(batches)
+    params, extra = task.init(ctx.seed_key, first)
+    state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                       extra_vars=extra, opt_state=tx.init(params),
+                       rng=jax.random.clone(ctx.seed_key))
+    state = shard_tree(state, ctx.mesh)
+    step = make_train_step(task, tx, schedule)
+    state, metrics = step(state, first)
+    state, metrics = step(state, next(batches))
+    result["loss"] = float(metrics["loss"])
+
+    # -- divergence detector: agreement, then an injected param flip -------
+    result["divergence_clean"] = divergence.check(state.params, step=2)
+    probe = {"w": jnp.ones((4,)) * (1.0 + proc_id)}  # differs per process
+    result["divergence_flagged"] = not divergence.check(probe, step=2)
+
+    # -- orbax multi-host save/restore round-trip --------------------------
+    ckpt = CheckpointManager(workdir / "ckpt")
+    ckpt.save(2, state, cfg, force=True)
+    ckpt.wait()
+    template = jax.tree.map(jnp.zeros_like, state)
+    restored, cfg_dict = ckpt.restore(2, template)
+    same = jax.tree.map(
+        lambda a, b: bool(np.array_equal(np.asarray(a), np.asarray(b))),
+        jax.device_get(jax.tree.map(lambda x: x, state.params)),
+        jax.device_get(restored.params),
+    )
+    result["ckpt_roundtrip"] = all(jax.tree.leaves(same))
+    result["ckpt_step"] = int(restored.step)
+    ckpt.close()
+
+    (workdir / f"result_{proc_id}.json").write_text(json.dumps(result))
+    shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

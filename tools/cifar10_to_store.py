@@ -1,0 +1,137 @@
+"""Convert CIFAR-10 binary batches into a memory-mapped array store.
+
+Parses the canonical *binary version* of CIFAR-10 directly — no torch, no
+tfds, no pickle: each record is 1 label byte followed by 3072 bytes of
+32x32 RGB in channel-planar order (1024 R, 1024 G, 1024 B). Output is a
+``StoreWriter`` store (uint8 NHWC ``image`` + int32 ``label``) that
+``--data_dir`` / ``--eval_data_dir`` consume, with normalisation and
+augmentation running on device (``models/task.py``). The reference's data
+layer only ever materialised ``torch.randn`` (``/root/reference/
+dataset.py:10-11``); this is the real-data rung it never had.
+
+Usage (with the corpus from https://www.cs.toronto.edu/~kriz/cifar.html)::
+
+    python tools/cifar10_to_store.py --src cifar-10-batches-bin \
+        --out /data/cifar10_train                       # data_batch_[1-5]
+    python tools/cifar10_to_store.py --src cifar-10-batches-bin \
+        --out /data/cifar10_test --split test           # test_batch.bin
+    python ddp.py --model resnet18 --data_dir /data/cifar10_train \
+        --eval_data_dir /data/cifar10_test --augment crop-flip --bf16 ...
+
+Offline environments: ``--fabricate N`` writes a *learnable* stand-in
+corpus in the exact same binary format (class-conditional patterns + pixel
+noise), so the full parse → store → train → eval pipeline is exercisable
+and a trained model's eval accuracy is meaningfully above chance. The
+record format, not the images, is what this tool owns.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+RECORD_BYTES = 1 + 32 * 32 * 3  # label byte + channel-planar RGB
+TRAIN_FILES = [f"data_batch_{i}.bin" for i in range(1, 6)]
+TEST_FILES = ["test_batch.bin"]
+
+
+def parse_batch_file(path: Path) -> tuple[np.ndarray, np.ndarray]:
+    """One binary batch file → ``(images_NHWC_uint8, labels_int32)``."""
+    raw = np.frombuffer(path.read_bytes(), dtype=np.uint8)
+    if raw.size == 0 or raw.size % RECORD_BYTES:
+        raise ValueError(
+            f"{path}: {raw.size} bytes is not a multiple of the "
+            f"{RECORD_BYTES}-byte CIFAR-10 record (1 label + 3072 pixels)"
+        )
+    records = raw.reshape(-1, RECORD_BYTES)
+    labels = records[:, 0].astype(np.int32)
+    if labels.max(initial=0) > 9:
+        raise ValueError(
+            f"{path}: label {labels.max()} > 9 — not CIFAR-10 binary "
+            "(CIFAR-100 records carry 2 label bytes)"
+        )
+    # planar (3, 32, 32) → NHWC
+    images = records[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return np.ascontiguousarray(images), labels
+
+
+def convert(src: Path, out: Path, files: list[str]) -> int:
+    from pytorch_ddp_template_tpu.data.filestore import StoreWriter
+
+    missing = [f for f in files if not (src / f).is_file()]
+    if missing:
+        raise FileNotFoundError(
+            f"{src} lacks {missing}; expected the extracted "
+            "cifar-10-batches-bin directory"
+        )
+    n = 0
+    with StoreWriter(out) as w:
+        for name in files:
+            images, labels = parse_batch_file(src / name)
+            w.append({"image": images, "label": labels})
+            n += len(labels)
+    return n
+
+
+def fabricate(src: Path, samples: int, seed: int) -> None:
+    """Write a learnable stand-in corpus in CIFAR-10 binary format.
+
+    Each class gets a fixed random 32x32x3 prototype; samples are the
+    prototype + heavy pixel noise, so a conv net separates the classes but
+    nothing is trivially constant. Written as the standard 5-train-batch +
+    1-test-batch file layout so ``convert`` exercises the real parser.
+    """
+    rng = np.random.default_rng(seed)
+    protos = rng.integers(32, 224, (10, 32, 32, 3)).astype(np.int16)
+
+    def records(count: int) -> bytes:
+        labels = rng.integers(0, 10, count)
+        noise = rng.integers(-80, 81, (count, 32, 32, 3))
+        imgs = np.clip(protos[labels] + noise, 0, 255).astype(np.uint8)
+        planar = imgs.transpose(0, 3, 1, 2).reshape(count, -1)
+        out = np.empty((count, RECORD_BYTES), np.uint8)
+        out[:, 0] = labels
+        out[:, 1:] = planar
+        return out.tobytes()
+
+    src.mkdir(parents=True, exist_ok=True)
+    per = max(1, samples // 5)
+    for name in TRAIN_FILES:
+        (src / name).write_bytes(records(per))
+    (src / TEST_FILES[0]).write_bytes(records(max(1, samples // 5)))
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--src", required=True,
+                   help="directory holding the CIFAR-10 binary batch files")
+    p.add_argument("--out", required=True, help="store directory to write")
+    p.add_argument("--split", choices=["train", "test"], default="train")
+    p.add_argument("--fabricate", type=int, default=0, metavar="N",
+                   help="first write a learnable stand-in corpus of ~N train "
+                        "samples in CIFAR-10 binary format into --src "
+                        "(offline environments; see module docstring)")
+    p.add_argument("--seed", type=int, default=0, help="for --fabricate")
+    args = p.parse_args(argv)
+
+    src, out = Path(args.src), Path(args.out)
+    if args.fabricate:
+        fabricate(src, args.fabricate, args.seed)
+        print(f"fabricated stand-in corpus under {src}")
+    t0 = time.perf_counter()
+    files = TRAIN_FILES if args.split == "train" else TEST_FILES
+    n = convert(src, out, files)
+    total = sum(f.stat().st_size for f in out.glob("*.bin"))
+    print(f"wrote {n} samples ({total / 1e6:.1f} MB) to {out} "
+          f"in {time.perf_counter() - t0:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
