@@ -7,9 +7,11 @@ parallelism the ``seq`` axis with two interchangeable engines: ring
 attention (``ring.py``, n ppermute hops) and Ulysses all-to-all
 (``ulysses.py``, 2 collectives + dense local attention). Pipeline
 parallelism gets a minimal GPipe mechanism over the ``pipe`` axis
-(``pipeline.py``).
+(``pipeline.py``); expert parallelism a minimal all_to_all MoE dispatch
+over the ``expert`` axis (``expert.py``).
 """
 
+from .expert import expert_apply, stack_expert_params
 from .pipeline import pipeline_apply, stack_stage_params
 from .ring import ring_attention, ring_attention_local
 from .sharding import (
@@ -26,7 +28,9 @@ __all__ = [
     "DEFAULT_RULES",
     "active_rules",
     "describe",
+    "expert_apply",
     "logical_shardings",
+    "stack_expert_params",
     "pipeline_apply",
     "ring_attention",
     "ring_attention_local",

@@ -23,21 +23,16 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..runtime.context import PIPE_AXIS
+from .stacking import check_leading_axis, stack_params
 
 
 def stack_stage_params(per_stage: list[Any], mesh: Mesh) -> Any:
     """Stack per-stage pytrees on a new leading axis and shard it over
     ``pipe`` — each pipeline rank holds only its own stage's weights."""
-    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage)
-    return jax.tree.map(
-        lambda x: jax.device_put(
-            x, NamedSharding(mesh, P(PIPE_AXIS, *([None] * (x.ndim - 1))))
-        ),
-        stacked,
-    )
+    return stack_params(per_stage, mesh, PIPE_AXIS)
 
 
 def pipeline_apply(
@@ -63,15 +58,7 @@ def pipeline_apply(
     """
     n_stages = mesh.shape[PIPE_AXIS]
     n_micro = x.shape[0]
-    leading = {leaf.shape[0] for leaf in jax.tree.leaves(stage_params)}
-    if leading != {n_stages}:
-        # a mismatch would shard >1 stage per rank and the per-rank [0]
-        # slice below would silently drop the rest — corruption, not an
-        # error, so refuse here
-        raise ValueError(
-            f"stage_params leading axis {sorted(leading)} != pipe axis size "
-            f"{n_stages}; stack exactly one stage per pipeline rank"
-        )
+    check_leading_axis(stage_params, n_stages, "pipe axis")
 
     from jax import shard_map
 

@@ -1,0 +1,34 @@
+"""Shared helper: stack per-rank pytrees on a leading mesh-axis-sharded
+dim. Used by the pipeline (one stage per ``pipe`` rank) and expert (one
+expert per ``expert`` rank) mechanisms."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def stack_params(per_item: list[Any], mesh: Mesh, axis: str) -> Any:
+    """Stack per-item pytrees on a new leading axis sharded over ``axis``
+    — each rank of that mesh axis holds exactly one item's weights."""
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *per_item)
+    return jax.tree.map(
+        lambda x: jax.device_put(
+            x, NamedSharding(mesh, P(axis, *([None] * (x.ndim - 1))))
+        ),
+        stacked,
+    )
+
+
+def check_leading_axis(params: Any, n: int, axis_desc: str) -> None:
+    """Refuse a stacked-params/mesh-axis size mismatch: sharding >1 item
+    per rank and slicing ``[0]`` would silently drop the rest."""
+    leading = {leaf.shape[0] for leaf in jax.tree.leaves(params)}
+    if leading != {n}:
+        raise ValueError(
+            f"params leading axis {sorted(leading)} != {axis_desc} size "
+            f"{n}; stack exactly one item per rank"
+        )

@@ -34,9 +34,12 @@ from ..utils import get_logger, redirect_warnings_to_logger
 
 log = get_logger(__name__)
 
-#: Canonical mesh axis names. ``data`` carries the DDP capability; the rest
-#: keep the mesh extensible to tensor/sequence/pipeline/expert parallelism
-#: (SURVEY.md §2b: leave a model axis open).
+#: Canonical mesh axis names, each with a real mechanism: ``data`` carries
+#: the DDP capability (sharding-induced psum), ``model`` tensor-parallel
+#: weight sharding (parallel/sharding.py), ``seq`` ring/Ulysses context
+#: parallelism (parallel/ring.py, ulysses.py), ``pipe`` the GPipe schedule
+#: (parallel/pipeline.py), ``expert`` all_to_all MoE dispatch
+#: (parallel/expert.py).
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
