@@ -1,0 +1,104 @@
+"""Failure-recovery rehearsal (SURVEY.md §5.3): a training process dies
+hard mid-run (``os._exit`` right after a checkpoint lands — no atexit, no
+final save), is restarted, and must converge to the exact final state an
+uninterrupted run produces — checkpoints + deterministic (seed, step) data
+order are the whole recovery story. (The reference's checkpoints could not
+even be loaded: ``/root/reference/ddp.py:293`` vs ``:206``.)
+
+Runs in 1-device subprocesses: determinism must come from keying, not luck
+in collective scheduling."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+
+SCRIPT = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 1)
+import json, os
+import numpy as np
+
+crash_at = {crash_at}
+if crash_at is not None:
+    # die HARD right after checkpoint `crash_at` is durably on disk —
+    # simulates a mid-run crash with no clean teardown
+    from pytorch_ddp_template_tpu.checkpoint import manager as mgr
+    _orig = mgr.CheckpointManager.save
+    def save_then_die(self, step, state, config, *, force=False):
+        _orig(self, step, state, config, force=force)
+        self.wait()
+        if step == crash_at:
+            os._exit(9)
+    mgr.CheckpointManager.save = save_then_die
+
+import ddp
+code = ddp.main([
+    "--model", "mlp", "--mesh", "data:1",
+    "--per_device_train_batch_size", "8", "--dataset_size", "256",
+    "--max_steps", "24", "--save_steps", "6", "--logging_steps", "0",
+    "--seed", "7", "--learning_rate", "0.01",
+    "--output_dir", {outdir!r},
+])
+assert code == 0
+
+from pytorch_ddp_template_tpu.config import TrainingConfig
+from pytorch_ddp_template_tpu.models import build
+from pytorch_ddp_template_tpu.runtime import init
+from pytorch_ddp_template_tpu.train import Trainer
+cfg = TrainingConfig(output_dir={outdir!r}, model="mlp", mesh="data:1",
+                     per_device_train_batch_size=8, dataset_size=256, seed=7)
+ctx = init(cfg)
+task, ds = build("mlp", cfg)
+t = Trainer(cfg, ctx, task, ds)
+state, step = t.restore_or_init()
+leaves = [np.asarray(x).ravel() for x in jax.tree.leaves(jax.device_get(state.params))]
+print("FINGERPRINT", json.dumps({{"step": step,
+      "digest": [float(np.sum(v)) for v in leaves]}}))
+"""
+
+
+def _run(outdir: Path, crash_at: int | None = None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = str(REPO)
+    p = subprocess.run(
+        [sys.executable, "-u", "-c",
+         SCRIPT.format(crash_at=crash_at, outdir=str(outdir))],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    if crash_at is not None:
+        assert p.returncode == 9, f"expected hard crash:\n{p.stdout[-3000:]}"
+        return None
+    assert p.returncode == 0, p.stdout[-3000:] + p.stderr[-2000:]
+    for line in p.stdout.splitlines():
+        if line.startswith("FINGERPRINT "):
+            return json.loads(line[len("FINGERPRINT "):])
+    raise AssertionError(f"no fingerprint in output:\n{p.stdout[-2000:]}")
+
+
+def test_crashed_run_resumes_to_identical_state(tmp_path):
+    baseline_dir = tmp_path / "uninterrupted"
+    crashed_dir = tmp_path / "crashed"
+    baseline_dir.mkdir()
+    crashed_dir.mkdir()
+
+    baseline = _run(baseline_dir)
+    assert baseline["step"] == 24
+
+    assert _run(crashed_dir, crash_at=12) is None  # really died (exit 9)
+    ckpts = sorted(int(d.name.split("_")[1])
+                   for d in crashed_dir.glob("checkpoint_*"))
+    assert ckpts == [6, 12], ckpts  # died after 12; 18/24 never happened
+
+    resumed = _run(crashed_dir)
+    assert resumed["step"] == 24
+    np.testing.assert_allclose(resumed["digest"], baseline["digest"],
+                               rtol=1e-6, atol=1e-8)
