@@ -9,11 +9,16 @@ activations flowing stage-to-stage over ``lax.ppermute`` (ICI
 neighbour hops on hardware), the schedule a ``lax.fori_loop`` over
 ``M + P - 1`` ticks with masked inactivity in the bubbles.
 
-Scope (deliberate): forward-only, equal-shaped stages (the transformer
-layer-stack case), no 1F1B interleaving — a mechanism proof sized to the
-capability envelope, not a Megatron replacement. ``stage_params`` carries a
-stacked leading stage axis sharded over ``pipe``, which is exactly how a
-layer-stacked ``lax.scan`` transformer would shard its weights for PP.
+Scope (deliberate): equal-shaped stages (the transformer layer-stack
+case), no 1F1B interleaving — a mechanism proof sized to the capability
+envelope, not a Megatron replacement. It *is* trainable: the fill/drain
+loop has a static trip count, so JAX rewrites the ``fori_loop`` to a
+``scan`` at trace time (a While loop proper would not be reverse-mode
+differentiable) and AD flows through the ``ppermute`` hops — ``jax.grad``
+through ``pipeline_apply`` matches sequential-stage gradients to float32
+tolerance (tests/test_pipeline.py). ``stage_params`` carries a stacked leading stage
+axis sharded over ``pipe``, which is exactly how a layer-stacked
+``lax.scan`` transformer would shard its weights for PP.
 """
 
 from __future__ import annotations

@@ -70,3 +70,32 @@ def test_stage_count_mismatch_refused():
     x = jax.random.normal(rngs[-1], (2, 4, d))
     with pytest.raises(ValueError, match="pipe axis"):
         pipeline_apply(params, stage_fn, x, mesh)
+
+
+def test_gradients_flow_through_schedule():
+    """The fill/drain loop has a static trip count (lowers to scan), so
+    reverse-mode AD through the ppermute hops must reproduce sequential
+    stage gradients — the pipeline is trainable, not just a fwd proof."""
+    d = 4
+    mesh = make_mesh("pipe:2", jax.devices()[:2])
+    rngs = jax.random.split(jax.random.PRNGKey(3), 3)
+    stages = [make_stage(rngs[i], d) for i in range(2)]
+    x = jax.random.normal(rngs[-1], (3, 2, d))
+
+    def loss_pipe(params):
+        return jnp.sum(pipeline_apply(params, stage_fn, x, mesh) ** 2)
+
+    def loss_seq(stage_list):
+        y = x
+        for w in stage_list:
+            y = jax.vmap(lambda xb, w=w: stage_fn(w, xb))(y)
+        return jnp.sum(y ** 2)
+
+    g_pipe = jax.grad(loss_pipe)(stack_stage_params(stages, mesh))
+    g_seq = jax.grad(loss_seq)(stages)
+    for i in range(2):
+        for key in ("kernel", "bias"):
+            np.testing.assert_allclose(
+                np.asarray(g_pipe[key][i]), np.asarray(g_seq[i][key]),
+                rtol=1e-5, atol=1e-6,
+            )

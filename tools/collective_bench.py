@@ -103,12 +103,29 @@ def main(argv=None) -> int:
             None,
             1.0 / n,  # each chip sends its shard one hop
         ),
+        # all_to_all: the MoE dispatch primitive (parallel/expert.py).
+        # Each rank splits its shard n ways and exchanges; (n-1)/n of
+        # every shard crosses the wire.
+        "all_to_all": (
+            lambda x: shard_map(
+                lambda v: lax.all_to_all(
+                    v.reshape(n, -1), axis, split_axis=0, concat_axis=0
+                ).reshape(-1),
+                mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False,
+            )(x),
+            None,
+            1.0 * (n - 1) / n,
+        ),
     }
 
     ok_all = True
     for name, (fn, _, bus_factor) in collectives.items():
         for elems in sizes:
-            elems = (elems // n) * n
+            # all_to_all re-splits each shard n ways; the rest need only n.
+            # Never round to zero — an empty array would time a no-op and
+            # count a vacuous "correct" toward the verdict.
+            quantum = n * n if name == "all_to_all" else n
+            elems = max((elems // quantum) * quantum, quantum)
             host = np.arange(elems, dtype=np.float32)
             x = jax.device_put(jnp.asarray(host), sharding)
             dt, y = _bench(jax.jit(fn), x, iters=args.iters)
@@ -121,6 +138,11 @@ def main(argv=None) -> int:
                 good = np.allclose(y, want)
             elif name == "all_gather":
                 good = np.array_equal(y, host)
+            elif name == "all_to_all":
+                # rank r ends with chunk r of every source, source-ordered:
+                # a (source, chunk) transpose of the sharded layout
+                want = host.reshape(n, n, -1).transpose(1, 0, 2).reshape(-1)
+                good = np.array_equal(y, want)
             else:  # ppermute: shard i receives shard i-1
                 want = host.reshape(n, -1)[(np.arange(n) - 1) % n].reshape(-1)
                 good = np.array_equal(y, want)

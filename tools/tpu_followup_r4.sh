@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Round-4 TPU follow-up suite: runs the measurements that were blocked by
+# the tunnel outage. Safe to re-run; each mode appends one JSON line.
+# Usage: bash tools/tpu_followup_r4.sh   (requires the axon tunnel up)
+set -u
+cd "$(dirname "$0")/.."
+R=bench_records
+mkdir -p "$R"
+
+run() { # name, env..., — logs one JSON line or the error
+  local name=$1; shift
+  echo "=== $name ===" >&2
+  env "$@" timeout 900 python bench.py 2>>"$R/.followup.err" | tee -a "$R/followup_tpu_r4.jsonl"
+}
+
+# 1. flash at seq 512: decides whether FLASH_MIN_SEQ can drop to 512
+#    (bert-base regime; policy currently routes 512 to XLA, unmeasured)
+run flash512 BENCH_MODE=flash BENCH_SEQ=512
+
+# 2. bert-base train under the current dispatch policy (XLA at 512) —
+#    compare with the pre-policy record 208.08 seq/s (train_tpu_r4.jsonl)
+run bert BENCH_MODE=train BENCH_MODEL=bert-base
+
+# 3. e2e vs cached-batch on the flagship: quantify the input path on TPU
+run e2e_rn50 BENCH_MODE=e2e BENCH_MODEL=resnet50
+
+# 4. long-context single chip: gpt-long trains with flash at 4096 in situ
+run gpt_long BENCH_MODE=train BENCH_MODEL=gpt-long BENCH_BATCH=1 BENCH_STEPS=10
+
+echo "done; records in $R/followup_tpu_r4.jsonl" >&2
