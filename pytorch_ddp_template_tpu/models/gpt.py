@@ -35,6 +35,7 @@ class GptDecoder(nn.Module):
     attn_impl: str = "auto"  # Impl | "ring" (context parallelism)
     mesh: jax.sharding.Mesh | None = None
     remat: bool = False
+    moe_experts: int = 0  # >0: MoE FFN (models/moe.py) in every block
 
     @nn.compact
     def __call__(self, input_ids, *, train: bool = True):
@@ -65,6 +66,7 @@ class GptDecoder(nn.Module):
             mesh=self.mesh,
             causal=True,
             remat=self.remat,
+            moe_experts=self.moe_experts,
             name="decoder",
         )(x, train=train)
         x = nn.LayerNorm(dtype=jnp.float32, name="final_ln")(x)
@@ -132,3 +134,14 @@ def gpt_tiny(dtype=jnp.float32, attn_impl: str = "auto", seq_len: int = 128,
     return GptDecoder(vocab_size=vocab_size, max_len=seq_len, num_layers=2,
                       num_heads=2, head_dim=32, mlp_dim=128, dtype=dtype,
                       attn_impl=attn_impl)
+
+
+def gpt_moe_tiny(dtype=jnp.float32, seq_len: int = 128,
+                 vocab_size: int = 1024, mesh=None,
+                 num_experts: int = 4) -> GptDecoder:
+    """Test-sized MoE GPT: every block's FFN is a top-1 mixture of
+    ``num_experts`` experts (models/moe.py); with an ``expert`` mesh axis
+    the experts shard and tokens flow over all_to_all dispatch."""
+    return GptDecoder(vocab_size=vocab_size, max_len=seq_len, num_layers=2,
+                      num_heads=2, head_dim=32, mlp_dim=128, dtype=dtype,
+                      mesh=mesh, moe_experts=num_experts)

@@ -1,0 +1,114 @@
+"""Mixture-of-experts feed-forward block for the transformer zoo.
+
+No counterpart in the reference (zoo = one MLP, ``/root/reference/
+model.py:8-16``) — this integrates the expert-parallel mechanism
+(``parallel/expert.py``) into a real model family: a top-1-routed FFN
+drop-in for ``MlpBlock``, selected per block via
+``TransformerEncoder(moe_experts=E)``.
+
+Two execution paths, numerically identical (tests/test_moe.py):
+
+- **dispatch** (mesh has an ``expert`` axis of size > 1): the real
+  expert-parallel dataflow — ``all_to_all`` token exchange to
+  expert-sharded weights. Capacity is each rank's full token count, and a
+  top-1 source can never route more than that to one expert, so nothing
+  drops and the paths agree exactly.
+- **dense** (no expert axis): every expert computes every token, the
+  router one-hot selects — the correct-by-construction baseline for tiny
+  meshes and CPU CI.
+
+Expert weights carry the ``expert`` logical axis, which
+``parallel/sharding.py`` maps onto the ``expert`` mesh axis — one expert's
+weights per rank, the standard EP layout.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..runtime.context import DATA_AXIS, EXPERT_AXIS
+from .transformer import default_kernel_init
+
+
+class MoeMlpBlock(nn.Module):
+    """Top-1-routed position-wise FFN over ``num_experts`` experts.
+
+    The expert output is scaled by the token's top-1 softmax gate
+    probability — the standard trick that gives the router a gradient
+    (argmax alone is piecewise-constant and would freeze routing at
+    initialization)."""
+
+    num_experts: int
+    mlp_dim: int
+    dtype: jnp.dtype = jnp.float32
+    mesh: jax.sharding.Mesh | None = None
+    act: Callable = nn.gelu
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = True):
+        d = x.shape[-1]
+        e = self.num_experts
+        part = nn.with_logical_partitioning
+        # gate replicated (("embed", None)): expert_apply's contract, and
+        # splitting a (d, E) vector per expert rank would buy nothing
+        gate = self.param("gate", part(default_kernel_init, ("embed", None)),
+                          (d, e), jnp.float32)
+        w_in = self.param("w_in",
+                          part(default_kernel_init, ("expert", "embed", "mlp")),
+                          (e, d, self.mlp_dim), jnp.float32)
+        b_in = self.param("b_in", part(nn.initializers.zeros, ("expert", "mlp")),
+                          (e, self.mlp_dim), jnp.float32)
+        w_out = self.param("w_out",
+                           part(default_kernel_init, ("expert", "mlp", "embed")),
+                           (e, self.mlp_dim, d), jnp.float32)
+        b_out = self.param("b_out", part(nn.initializers.zeros, ("expert", "embed")),
+                           (e, d), jnp.float32)
+
+        tokens = x.reshape(-1, d)
+        params = {
+            "w_in": w_in.astype(self.dtype), "b_in": b_in.astype(self.dtype),
+            "w_out": w_out.astype(self.dtype), "b_out": b_out.astype(self.dtype),
+        }
+        gate_c = gate.astype(self.dtype)
+
+        def expert_fn(w, t):
+            return self.act(t @ w["w_in"] + w["b_in"]) @ w["w_out"] + w["b_out"]
+
+        mesh = self.mesh
+        ep = mesh.shape.get(EXPERT_AXIS, 1) if mesh is not None else 1
+        dp = mesh.shape.get(DATA_AXIS, 1) if mesh is not None else 1
+        # dispatch needs exactly one expert per expert-axis rank (the
+        # minimal mechanism's layout); other expert counts use the dense
+        # path with weights still sharded per the logical annotations
+        if ep > 1 and ep == e and tokens.shape[0] % (ep * dp) == 0:
+            from ..parallel.expert import expert_apply
+
+            # batch_axis: each data group dispatches only its own tokens —
+            # without it the global token set would replicate over data and
+            # every data rank would duplicate the expert FFN compute
+            y = expert_apply(params, expert_fn, gate_c, tokens, mesh,
+                             batch_axis=DATA_AXIS if dp > 1 else None)
+        else:
+            # dense fallback: every expert computes every token; the
+            # router's one-hot selects. O(E) flops — fine at proof scale.
+            dest = jnp.argmax(tokens @ gate_c, axis=-1)
+            ys = jax.vmap(
+                lambda wi, bi, wo, bo: self.act(tokens @ wi + bi) @ wo + bo
+            )(*(params[k] for k in ("w_in", "b_in", "w_out", "b_out")))
+            onehot = jax.nn.one_hot(dest, e, dtype=ys.dtype)
+            y = jnp.einsum("etd,te->td", ys, onehot)
+
+        # scale by the top-1 gate probability: the router's gradient path
+        # (computed in f32; identical on both branches since both route by
+        # argmax of the same logits)
+        logits = (tokens @ gate_c).astype(jnp.float32)  # same routing logits
+        top_p = jnp.max(jax.nn.softmax(logits, axis=-1), axis=-1)
+        y = y * top_p[:, None].astype(y.dtype)
+        if self.dropout_rate:
+            y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        return y.reshape(x.shape).astype(self.dtype)

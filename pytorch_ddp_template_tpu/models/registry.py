@@ -282,6 +282,23 @@ def _gpt_tiny(config: TrainingConfig):
     return _token_entry(config, task, seq_len, vocab)
 
 
+@register("gpt-moe-tiny")
+def _gpt_moe_tiny(config: TrainingConfig, mesh=None):
+    """Tiny MoE causal LM: top-1 expert FFNs, expert-parallel over the
+    ``expert`` mesh axis when present (CPU-CI exercisable)."""
+    from ..runtime import make_mesh
+    from .gpt import CausalLmTask, gpt_moe_tiny
+
+    import jax
+
+    if mesh is None:
+        mesh = make_mesh(config.mesh, jax.devices())
+    seq_len, vocab = 128, 1024
+    task = CausalLmTask(gpt_moe_tiny(dtype=_dtype(config), seq_len=seq_len,
+                                     vocab_size=vocab, mesh=mesh))
+    return _token_entry(config, task, seq_len, vocab)
+
+
 @register("gpt-long")
 def _gpt_long(config: TrainingConfig, mesh=None):
     """Long-context GPT (4096 tokens): causal ring attention over the

@@ -147,6 +147,7 @@ class EncoderBlock(nn.Module):
     attn_impl: str = "auto"
     mesh: jax.sharding.Mesh | None = None
     causal: bool = False
+    moe_experts: int = 0  # >0: FFN = top-1 MoE over this many experts
 
     @nn.compact
     def __call__(self, x, mask=None, train: bool = True):
@@ -158,7 +159,15 @@ class EncoderBlock(nn.Module):
             self.dropout_rate, self.attn_impl, self.mesh, self.causal,
             name="attention",
         )
-        mlp = MlpBlock(self.mlp_dim, self.dtype, self.dropout_rate, name="mlp")
+        if self.moe_experts:
+            from .moe import MoeMlpBlock
+
+            mlp = MoeMlpBlock(self.moe_experts, self.mlp_dim, self.dtype,
+                              self.mesh, dropout_rate=self.dropout_rate,
+                              name="mlp")
+        else:
+            mlp = MlpBlock(self.mlp_dim, self.dtype, self.dropout_rate,
+                           name="mlp")
         if self.pre_norm:
             x = x + attn(ln("ln_attn")(x).astype(self.dtype), mask, train=train)
             x = x + mlp(ln("ln_mlp")(x).astype(self.dtype), train=train)
@@ -186,6 +195,7 @@ class TransformerEncoder(nn.Module):
     mesh: jax.sharding.Mesh | None = None
     causal: bool = False
     remat: bool = False
+    moe_experts: int = 0
 
     @nn.compact
     def __call__(self, x, mask=None, *, train: bool = True):
@@ -196,7 +206,8 @@ class TransformerEncoder(nn.Module):
             block = block_cls(
                 self.num_heads, self.head_dim, self.mlp_dim, self.dtype,
                 self.dropout_rate, self.pre_norm, self.attn_impl, self.mesh,
-                self.causal, name=f"layer_{layer}",
+                self.causal, moe_experts=self.moe_experts,
+                name=f"layer_{layer}",
             )
             x = block(x, mask, train) if self.remat else block(
                 x, mask, train=train)

@@ -48,6 +48,7 @@ def expert_apply(
     mesh: Mesh,
     *,
     capacity: int | None = None,
+    batch_axis: str | None = None,
 ) -> jax.Array:
     """Top-1-routed expert computation with all_to_all dispatch/combine.
 
@@ -56,19 +57,24 @@ def expert_apply(
         :func:`stack_expert_params`), sharded over ``expert``.
       expert_fn: ``(params_of_one_expert, (n, d) tokens) -> (n, d)``.
       gate_w: ``(d, E)`` router weights, replicated.
-      x: ``(T, d)`` tokens with ``T`` divisible by ``E``, sharded over
-        ``expert`` on the token dim (each rank owns ``T/E`` tokens).
+      x: ``(T, d)`` tokens, sharded over ``(batch_axis?, expert)`` on the
+        token dim. ``T`` must divide by the product of those axis sizes.
       capacity: max tokens any one source rank may send to one expert;
-        default ``T/E`` (never drops under balanced routing).
+        default = each rank's local token count (top-1 then never drops).
+      batch_axis: optional data-parallel mesh axis to ALSO split tokens
+        over — each data group then dispatches only its own tokens to its
+        (replicated-over-data) experts, instead of replicating the global
+        token set and duplicating expert compute per data rank.
 
     Returns ``(T, d)``: per-token expert outputs (dropped tokens → 0).
     """
     n_experts = mesh.shape[EXPERT_AXIS]
     check_leading_axis(expert_params, n_experts, "expert axis")
     tokens, d = x.shape
-    if tokens % n_experts:
-        raise ValueError(f"token count {tokens} not divisible by {n_experts}")
-    local = tokens // n_experts
+    groups = n_experts * (mesh.shape[batch_axis] if batch_axis else 1)
+    if tokens % groups:
+        raise ValueError(f"token count {tokens} not divisible by {groups}")
+    local = tokens // groups
     cap = local if capacity is None else capacity
 
     from jax import shard_map
@@ -108,10 +114,11 @@ def expert_apply(
     in_param_spec = jax.tree.map(
         lambda a: P(EXPERT_AXIS, *([None] * (a.ndim - 1))), expert_params
     )
+    token_spec = P((batch_axis, EXPERT_AXIS)) if batch_axis else P(EXPERT_AXIS)
     return shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(in_param_spec, P(EXPERT_AXIS)),
-        out_specs=P(EXPERT_AXIS),
+        in_specs=(in_param_spec, token_spec),
+        out_specs=token_spec,
         check_vma=False,
     )(expert_params, x)

@@ -30,7 +30,7 @@ import flax.linen as nn
 import jax
 from jax.sharding import Mesh
 
-from ..runtime.context import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from ..runtime.context import DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, SEQ_AXIS
 
 #: logical axis -> preferred mesh axes, in priority order. A rule applies
 #: only if the mesh has that axis; otherwise the dim is replicated.
@@ -40,6 +40,7 @@ DEFAULT_RULES: tuple[tuple[str, str | None], ...] = (
     ("mlp", MODEL_AXIS),     # fc1 column-split
     ("heads", MODEL_AXIS),   # attention head-split
     ("vocab", MODEL_AXIS),   # embedding vocab-split
+    ("expert", EXPERT_AXIS),  # MoE expert-stack dim (models/moe.py)
     ("embed", None),         # row dim of fc1/qkv: replicated (activations
                              # stay unsharded along embed between blocks)
     ("kv", None),
@@ -128,4 +129,5 @@ def describe(mesh: Mesh) -> dict[str, Any]:
         "data_parallel": sizes.get(DATA_AXIS, 1),
         "tensor_parallel": sizes.get(MODEL_AXIS, 1),
         "context_parallel": sizes.get(SEQ_AXIS, 1),
+        "expert_parallel": sizes.get(EXPERT_AXIS, 1),
     }

@@ -1,0 +1,95 @@
+"""MoE model family: the expert-parallel mechanism integrated into a real
+transformer (``gpt-moe-tiny``). Pins path equivalence (all_to_all dispatch
+== dense routing), engine compatibility on an expert mesh, and learning."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_ddp_template_tpu.config import TrainingConfig
+from pytorch_ddp_template_tpu.models import available_models, build
+from pytorch_ddp_template_tpu.models.moe import MoeMlpBlock
+from pytorch_ddp_template_tpu.runtime import make_mesh
+
+
+class TestMoeBlock:
+    def test_dispatch_equals_dense_path(self):
+        """Same params, same input: the all_to_all expert-parallel path and
+        the dense fallback must agree (capacity never drops under top-1)."""
+        d, t = 16, 32
+        mesh = make_mesh("expert:4", jax.devices()[:4])
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, t // 2, d))
+
+        dispatch = MoeMlpBlock(num_experts=4, mlp_dim=32, mesh=mesh)
+        dense = MoeMlpBlock(num_experts=4, mlp_dim=32, mesh=None)
+        params = dispatch.init(jax.random.PRNGKey(1), x, train=False)
+        y_dispatch = dispatch.apply(params, x, train=False)
+        y_dense = dense.apply(params, x, train=False)
+        np.testing.assert_allclose(np.asarray(y_dispatch),
+                                   np.asarray(y_dense), rtol=1e-5, atol=1e-5)
+
+    def test_registered(self):
+        assert "gpt-moe-tiny" in available_models()
+
+
+class TestMoeTraining:
+    def _trainer(self, tmp_path, mesh_spec, **over):
+        from pytorch_ddp_template_tpu.runtime import init
+        from pytorch_ddp_template_tpu.train import Trainer
+
+        cfg = TrainingConfig(
+            output_dir=str(tmp_path / "o"), model="gpt-moe-tiny",
+            mesh=mesh_spec, per_device_train_batch_size=4, dataset_size=256,
+            logging_steps=0, save_steps=0, max_steps=12,
+            learning_rate=1e-2, optimizer="adam", **over,
+        )
+        ctx = init(cfg)
+        task, ds = build(cfg.model, cfg, mesh=ctx.mesh)
+        return Trainer(cfg, ctx, task, ds)
+
+    def test_trains_on_expert_mesh(self, tmp_path):
+        """Full engine over data:2,expert:4 (one expert per rank, so the
+        all_to_all dispatch path is live in the hot loop) — sharded
+        batches, expert-sharded weights; loss must descend."""
+        t = self._trainer(tmp_path, "data:2,expert:4")
+        state, _ = t.restore_or_init()
+        losses = []
+        for epoch in range(2):
+            for batch in t.loader.epoch(epoch):
+                state, metrics = t.train_step(state, batch)
+                losses.append(float(metrics["loss"]))
+        k = len(losses) // 4
+        assert sum(losses[-k:]) / k < sum(losses[:k]) / k, losses
+
+    def test_expert_weights_sharded_over_expert_axis(self, tmp_path):
+        t = self._trainer(tmp_path, "data:2,expert:4")
+        state, _ = t.restore_or_init()
+        flat = jax.tree_util.tree_flatten_with_path(state.params)[0]
+        moe_leaves = [
+            (jax.tree_util.keystr(path), leaf) for path, leaf in flat
+            if "w_in" in jax.tree_util.keystr(path)
+        ]
+        assert moe_leaves, "no MoE expert weights found in params"
+        for name, leaf in moe_leaves:
+            spec = leaf.sharding.spec
+            assert len(spec) >= 1 and spec[0] == "expert", (name, spec)
+
+
+class TestRouterGradient:
+    def test_gate_receives_gradient(self):
+        """The top-1 softmax scale must give the router a nonzero gradient
+        — argmax alone would freeze routing at initialization forever."""
+        d = 16
+        block = MoeMlpBlock(num_experts=4, mlp_dim=32, mesh=None)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, d))
+        params = block.init(jax.random.PRNGKey(1), x, train=False)
+
+        def loss(p):
+            return jnp.sum(block.apply(p, x, train=False) ** 2)
+
+        import flax.linen as nn
+
+        g = jax.grad(loss)(params)
+        gate_grad = np.asarray(nn.meta.unbox(g)["params"]["gate"])
+        assert np.abs(gate_grad).max() > 0, "router gate gradient is zero"

@@ -352,6 +352,7 @@ def test_describe_and_rules():
         "data_parallel": 2,
         "tensor_parallel": 2,
         "context_parallel": 2,
+        "expert_parallel": 1,
     }
     rules = dict(active_rules(mesh))
     assert rules["mlp"] == "model" and rules["batch"] == "data"
