@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Local two-process rehearsal launcher: the reference's multi-rank localhost
+# mode (run.sh: torch.distributed.launch with MASTER_ADDR=127.0.0.1) mapped
+# to JAX — two processes rendezvous through jax.distributed.initialize and
+# train ONE SPMD job over the union of their devices. On CPU each process
+# gets N virtual devices (DEVS_PER_PROC); on a multi-host TPU slice use
+# run_pod.sh instead (one process per host, addresses discovered).
+#
+# Usage: bash launch/run_local_2proc.sh [extra ddp.py flags...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+PORT=${PORT:-$((10000 + RANDOM % 40000))}
+DEVS_PER_PROC=${DEVS_PER_PROC:-4}
+MODEL=${MODEL:-mlp}
+OUTPUT_DIR=${OUTPUT_DIR:-outputs_2proc}
+
+run_rank() {
+  JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=${DEVS_PER_PROC}" \
+  python ddp.py \
+    --cpu \
+    --coordinator_address "127.0.0.1:${PORT}" \
+    --num_processes 2 \
+    --process_id "$1" \
+    --model "$MODEL" \
+    --output_dir "$OUTPUT_DIR" \
+    --per_device_train_batch_size "${PER_DEVICE_BATCH:-4}" \
+    --max_steps "${MAX_STEPS:-24}" \
+    --logging_steps "${LOGGING_STEPS:-8}" \
+    --save_steps "${SAVE_STEPS:-0}" \
+    "${@:2}"
+}
+
+run_rank 1 "$@" &
+WORKER=$!
+trap 'kill "$WORKER" 2>/dev/null || true' EXIT
+run_rank 0 "$@"
+wait "$WORKER"
