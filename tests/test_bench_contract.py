@@ -1,0 +1,68 @@
+"""Driver-contract tests for bench.py: every mode must emit exactly one
+parseable JSON line with the required keys on stdout, and failures must be
+JSON too (the driver records whatever this prints — a stack trace instead
+of a line is a lost round's evidence)."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def run_bench(extra_env: dict, timeout: int = 420) -> tuple[int, list[dict], str]:
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({"BENCH_CPU": "1", "BENCH_WARMUP": "1", "BENCH_STEPS": "2",
+                "JAX_PLATFORMS": "cpu", **extra_env})
+    p = subprocess.run([sys.executable, "bench.py"], cwd=REPO, env=env,
+                       capture_output=True, text=True, timeout=timeout)
+    lines = []
+    for line in p.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            lines.append(json.loads(line))
+    return p.returncode, lines, p.stdout + p.stderr
+
+
+REQUIRED = {"metric", "value", "unit", "vs_baseline"}
+
+
+def test_train_mode_contract():
+    code, lines, out = run_bench({"BENCH_MODE": "train", "BENCH_MODEL": "mlp",
+                                  "BENCH_BATCH": "8"})
+    assert code == 0, out[-2000:]
+    assert len(lines) == 1, out[-2000:]
+    assert REQUIRED <= set(lines[0])
+    assert lines[0]["value"] > 0
+
+
+def test_e2e_mode_reports_both_paths():
+    code, lines, out = run_bench({"BENCH_MODE": "e2e", "BENCH_MODEL": "mlp",
+                                  "BENCH_BATCH": "8",
+                                  "BENCH_OUTPUT": "/tmp/bench_e2e_test"})
+    assert code == 0, out[-2000:]
+    assert len(lines) == 1
+    row = lines[0]
+    assert REQUIRED <= set(row)
+    assert "cached_batch_per_chip" in row and "input_path_overhead_pct" in row
+    assert row["data_source"] == "synthetic"
+
+
+def test_scaling_mode_flags_degenerate_single_device():
+    code, lines, out = run_bench({"BENCH_MODE": "scaling", "BENCH_MODEL": "mlp",
+                                  "BENCH_BATCH": "8", "BENCH_CPU_DEVICES": "1"})
+    assert code == 0, out[-2000:]
+    row = lines[-1]
+    assert row["degenerate"] is True
+    assert row["vs_baseline"] == 0.0  # a 1-chip sweep must not read as a pass
+
+
+def test_unknown_mode_fails_as_json():
+    code, lines, out = run_bench({"BENCH_MODE": "typo"})
+    assert code == 1
+    assert len(lines) == 1, out[-2000:]
+    assert lines[0]["value"] == 0.0
+    assert "error" in lines[0]
