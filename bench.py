@@ -42,6 +42,9 @@ BASELINE_PER_DEVICE = {
 # Peak dense-matmul throughput per chip (bf16), for MFU. Sources: public
 # TPU spec sheets; GPU entries cover dev boxes so MFU stays meaningful.
 PEAK_FLOPS = {
+    "TPU v6e": 918e12,  # Trillium
+    "TPU v6 lite": 918e12,
+    "TPU v5p": 459e12,
     "TPU v5e": 197e12,
     "TPU v5 lite": 197e12,
     "TPU v4": 275e12,

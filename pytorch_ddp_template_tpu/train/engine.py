@@ -77,9 +77,13 @@ def make_optimizer(config: TrainingConfig, total_steps: int) -> tuple[optax.Grad
         opt = optax.adam(learning_rate=schedule, b1=config.adam_beta1,
                          b2=config.adam_beta2, eps=config.adam_eps)
     elif kind == "adamw":
+        # standard decay mask: norms/biases/other 1-D params are excluded
+        # (decaying a LayerNorm scale toward 0 fights the normalisation;
+        # every major transformer recipe masks these)
+        decay_mask = lambda params: jax.tree.map(lambda p: p.ndim > 1, params)
         opt = optax.adamw(learning_rate=schedule, b1=config.adam_beta1,
                           b2=config.adam_beta2, eps=config.adam_eps,
-                          weight_decay=config.weight_decay)
+                          weight_decay=config.weight_decay, mask=decay_mask)
     else:
         raise ValueError(f"unknown optimizer {kind!r}")
     tx = optax.chain(

@@ -286,3 +286,26 @@ class TestOptimizers:
         t2 = make_trainer(tmp_path, max_steps=4, optimizer="adam")
         with pytest.raises(ValueError, match="optimizer"):
             t2.restore_or_init()
+
+
+class TestWeightDecayMask:
+    def test_adamw_does_not_decay_1d_params(self, tmp_path):
+        """Norms/biases (ndim <= 1) must be excluded from decoupled weight
+        decay: with lr frozen via zero grads... instead, isolate decay by
+        running adamw with huge weight_decay on zero gradients — 2D kernels
+        must shrink, 1D biases must not move."""
+        import optax
+        from pytorch_ddp_template_tpu.train.engine import make_optimizer
+
+        cfg = TrainingConfig(output_dir=str(tmp_path), optimizer="adamw",
+                             weight_decay=0.5, learning_rate=1.0,
+                             warmup_steps=0)
+        tx, _ = make_optimizer(cfg, total_steps=10)
+        params = {"kernel": jnp.ones((4, 4)), "bias": jnp.ones((4,))}
+        grads = jax.tree.map(jnp.zeros_like, params)
+        state = tx.init(params)
+        updates, _ = tx.update(grads, state, params)
+        new = optax.apply_updates(params, updates)
+        assert float(jnp.abs(new["kernel"] - 1.0).max()) > 0.1  # decayed
+        np.testing.assert_array_equal(np.asarray(new["bias"]),
+                                      np.ones(4))  # masked: untouched
