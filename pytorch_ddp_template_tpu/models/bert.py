@@ -143,7 +143,7 @@ class MlmTask(Task):
             corrupted = jnp.where(attention_mask.astype(bool), corrupted,
                                   input_ids)
 
-        logits, extra_vars = self._apply_inputs(
+        logits, extra_vars, aux = self._apply_inputs(
             params, extra_vars, (corrupted, attention_mask), dropout_rng,
             train,
         )
@@ -161,7 +161,8 @@ class MlmTask(Task):
             loss=-(token_logp * sel).sum(),
             mlm_accuracy=(hits * sel).sum(),
         )
-        return metrics["loss"], extra_vars, metrics
+        total, metrics = self._with_aux(metrics, aux)
+        return total, extra_vars, metrics
 
 
 def bert_base(dtype=jnp.float32, attn_impl: str = "auto", remat: bool = False,

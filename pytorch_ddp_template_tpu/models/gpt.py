@@ -84,11 +84,9 @@ class CausalLmTask(Task):
 
     def loss(self, params, extra_vars, batch, rng, *, train=True):
         input_ids = batch["input_ids"]
-        variables = {"params": params, **extra_vars}
-        kwargs = {"train": train}
-        if train and rng is not None:
-            kwargs["rngs"] = {"dropout": rng}
-        logits = self.model.apply(variables, input_ids, **kwargs)
+        logits, extra_vars, aux = self._apply_inputs(
+            params, extra_vars, (input_ids,), rng, train
+        )
 
         # predict token t+1 from prefix ..t; last position has no target
         logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
@@ -102,7 +100,8 @@ class CausalLmTask(Task):
             loss=-(token_logp * w).sum(),
             next_token_accuracy=(hits * w).sum(),
         )
-        return metrics["loss"], extra_vars, metrics
+        total, metrics = self._with_aux(metrics, aux)
+        return total, extra_vars, metrics
 
 
 def gpt_small(dtype=jnp.float32, attn_impl: str = "auto", remat: bool = False,

@@ -107,8 +107,21 @@ class MoeMlpBlock(nn.Module):
         # (computed in f32; identical on both branches since both route by
         # argmax of the same logits)
         logits = (tokens @ gate_c).astype(jnp.float32)  # same routing logits
-        top_p = jnp.max(jax.nn.softmax(logits, axis=-1), axis=-1)
+        probs = jax.nn.softmax(logits, axis=-1)
+        top_p = jnp.max(probs, axis=-1)
         y = y * top_p[:, None].astype(y.dtype)
+
+        if train:
+            # Switch-style load-balance loss: E * sum_e f_e * P_e, where
+            # f_e = fraction of tokens routed to e, P_e = mean gate prob.
+            # Minimised at uniform routing; without it top-1 routing
+            # collapses onto few experts. Tasks read the "losses"
+            # collection and add it to the objective.
+            f = jnp.mean(jax.nn.one_hot(jnp.argmax(logits, -1), e,
+                                        dtype=jnp.float32), axis=0)
+            p_mean = jnp.mean(probs, axis=0)
+            self.sow("losses", "moe_load_balance", e * jnp.sum(f * p_mean),
+                     reduce_fn=lambda a, b: a + b, init_fn=lambda: 0.0)
         if self.dropout_rate:
             y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         return y.reshape(x.shape).astype(self.dtype)

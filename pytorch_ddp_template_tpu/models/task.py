@@ -85,24 +85,47 @@ class Task:
             metrics["__denom__"] = wsum
         return metrics
 
+    #: weight of sown auxiliary losses (e.g. the MoE load-balance term —
+    #: Switch Transformer's standard 1e-2)
+    aux_loss_weight = 0.01
+
     def _apply(self, params, extra_vars, batch, rng, train):
         return self._apply_inputs(params, extra_vars, self.model_inputs(batch),
                                   rng, train)
 
     def _apply_inputs(self, params, extra_vars, inputs, rng, train):
+        """Run the model; returns ``(preds, new_extra, aux)``.
+
+        ``aux`` sums the "losses" collection (modules sow auxiliary
+        objectives there, e.g. ``MoeMlpBlock``'s load-balance term) or is
+        ``None`` when nothing was sown. Harvesting here means EVERY task
+        supports aux-carrying models — a task that forgot would otherwise
+        silently train MoE routing with no balance term.
+        """
         variables = {"params": params, **extra_vars}
-        # flax returns (out, mutated) even for mutable=[], so only request
-        # mutation when there are collections to mutate
-        mutable = list(extra_vars) if (train and extra_vars) else False
+        # train mode always offers the "losses" collection for sowing;
+        # whether anything landed is statically known from the result
+        mutable = (list(extra_vars) + ["losses"]) if train else False
         kwargs: dict[str, Any] = {"train": train}
         if train and rng is not None:
             kwargs["rngs"] = {"dropout": rng}
         out = self.model.apply(variables, *inputs, mutable=mutable, **kwargs)
-        if mutable:
-            preds, new_extra = out
-        else:
-            preds, new_extra = out, extra_vars
-        return preds, new_extra
+        if mutable is False:
+            return out, extra_vars, None
+        preds, mutated = out
+        mutated = dict(mutated)
+        leaves = jax.tree.leaves(mutated.pop("losses", {}))
+        aux = sum(leaves, jnp.zeros((), jnp.float32)) if leaves else None
+        return preds, {**extra_vars, **mutated}, aux
+
+    def _with_aux(self, metrics: dict, aux):
+        """Total objective = data loss + weighted aux. ``metrics['loss']``
+        stays the pure data loss (comparable with eval curves); the
+        regulariser is logged separately as ``aux_loss``."""
+        if aux is None:
+            return metrics["loss"], metrics
+        metrics["aux_loss"] = aux
+        return metrics["loss"] + self.aux_loss_weight * aux, metrics
 
 
 class RegressionTask(Task):
@@ -113,13 +136,15 @@ class RegressionTask(Task):
         return (batch["x"],)
 
     def loss(self, params, extra_vars, batch, rng, *, train=True):
-        preds, new_extra = self._apply(params, extra_vars, batch, rng, train)
+        preds, new_extra, aux = self._apply(params, extra_vars, batch, rng,
+                                            train)
         err = jnp.square(preds.astype(jnp.float32) - batch["y"])
         per_example = err.reshape(err.shape[0], -1).mean(axis=1)
         w = self.example_weights(batch, per_example.shape[0])
         metrics = self.weighted_metrics(w.sum(), train,
                                         loss=(per_example * w).sum())
-        return metrics["loss"], new_extra, metrics
+        total, metrics = self._with_aux(metrics, aux)
+        return total, new_extra, metrics
 
 
 class ClassificationTask(Task):
@@ -171,7 +196,7 @@ class ClassificationTask(Task):
         if train and self.augment != "none" and rng is not None:
             aug_rng, rng = jax.random.split(rng)
             img = self._augment(img, aug_rng)
-        logits, new_extra = self._apply_inputs(
+        logits, new_extra, aux = self._apply_inputs(
             params, extra_vars, (img,), rng, train
         )
         logits = logits.astype(jnp.float32)
@@ -182,4 +207,5 @@ class ClassificationTask(Task):
         metrics = self.weighted_metrics(w.sum(), train,
                                         loss=(ce * w).sum(),
                                         accuracy=(correct * w).sum())
-        return metrics["loss"], new_extra, metrics
+        total, metrics = self._with_aux(metrics, aux)
+        return total, new_extra, metrics

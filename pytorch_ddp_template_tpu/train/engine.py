@@ -167,7 +167,9 @@ def make_train_step(
             opt_state=new_opt_state,
         )
         out_metrics = dict(metrics)
-        out_metrics["loss"] = loss
+        # tasks report the pure data loss in metrics (comparable with eval
+        # curves); the differentiated total may add regularisers (aux_loss)
+        out_metrics.setdefault("loss", loss)
         out_metrics["grad_norm"] = grad_norm
         out_metrics["lr"] = schedule(state.step)
         return new_state, out_metrics

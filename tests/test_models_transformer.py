@@ -43,7 +43,7 @@ def test_vit_tiny_loss_and_shapes():
     task, params, extra, batch = _loss_for("vit-tiny")
     loss, _, metrics = task.loss(params, extra, batch, jax.random.PRNGKey(1))
     assert abs(float(loss) - np.log(10)) < 0.7
-    logits, _ = task._apply(params, extra, batch, None, train=False)
+    logits, _, _ = task._apply(params, extra, batch, None, train=False)
     assert logits.shape == (8, 10)
 
 

@@ -93,3 +93,41 @@ class TestRouterGradient:
         g = jax.grad(loss)(params)
         gate_grad = np.asarray(nn.meta.unbox(g)["params"]["gate"])
         assert np.abs(gate_grad).max() > 0, "router gate gradient is zero"
+
+
+class TestLoadBalanceLoss:
+    def test_aux_loss_in_train_metrics_and_drives_gate(self, tmp_path):
+        """Training must carry the Switch load-balance term: present in
+        metrics, >= 1 (its minimum, at uniform routing), and feeding the
+        gate a balance gradient beyond the top-1 scale."""
+        from pytorch_ddp_template_tpu.runtime import init
+        from pytorch_ddp_template_tpu.train import Trainer
+
+        cfg = TrainingConfig(
+            output_dir=str(tmp_path / "o"), model="gpt-moe-tiny",
+            mesh="data:8", per_device_train_batch_size=1, dataset_size=64,
+            logging_steps=0, save_steps=0, max_steps=2,
+        )
+        ctx = init(cfg)
+        task, ds = build(cfg.model, cfg, mesh=ctx.mesh)
+        t = Trainer(cfg, ctx, task, ds)
+        state, _ = t.restore_or_init()
+        state, metrics = t.train_step(state, next(iter(t.loader.epoch(0))))
+        aux = float(metrics["aux_loss"])
+        assert np.isfinite(aux) and aux >= 1.0 - 1e-3, aux
+
+    def test_eval_metrics_carry_no_aux(self, tmp_path):
+        """Eval reports model quality, not the training regulariser."""
+        cfg = TrainingConfig(
+            output_dir=str(tmp_path / "o"), model="gpt-moe-tiny",
+            mesh="data:8", per_device_train_batch_size=1, dataset_size=64,
+            logging_steps=0, save_steps=0,
+        )
+        from pytorch_ddp_template_tpu.runtime import init
+
+        ctx = init(cfg)
+        task, ds = build(cfg.model, cfg, mesh=ctx.mesh)
+        batch = {k: jnp.asarray(v) for k, v in ds.batch(np.arange(8)).items()}
+        params, extra = task.init(jax.random.PRNGKey(0), batch)
+        _, _, m = task.loss(params, extra, batch, None, train=False)
+        assert "aux_loss" not in m
