@@ -97,9 +97,7 @@ class MoeMlpBlock(nn.Module):
             # dense fallback: every expert computes every token; the
             # router's one-hot selects. O(E) flops — fine at proof scale.
             dest = jnp.argmax(tokens @ gate_c, axis=-1)
-            ys = jax.vmap(
-                lambda wi, bi, wo, bo: self.act(tokens @ wi + bi) @ wo + bo
-            )(*(params[k] for k in ("w_in", "b_in", "w_out", "b_out")))
+            ys = jax.vmap(lambda w: expert_fn(w, tokens))(params)
             onehot = jax.nn.one_hot(dest, e, dtype=ys.dtype)
             y = jnp.einsum("etd,te->td", ys, onehot)
 

@@ -101,7 +101,7 @@ def main() -> int:
     restored, cfg_dict = ckpt.restore(2, template)
     same = jax.tree.map(
         lambda a, b: bool(np.array_equal(np.asarray(a), np.asarray(b))),
-        jax.device_get(jax.tree.map(lambda x: x, state.params)),
+        jax.device_get(state.params),
         jax.device_get(restored.params),
     )
     result["ckpt_roundtrip"] = all(jax.tree.leaves(same))

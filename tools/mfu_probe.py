@@ -120,22 +120,21 @@ def main() -> None:
 
     kind = devices[0].device_kind
     peak = next((v for k, v in bench.PEAK_FLOPS.items() if k in kind), None)
-    rows = []
     t_step = None
+
+    # the step donates its input state; rethread it every call
+    holder = {"state": state}
+
+    def step_call():
+        holder["state"], m = step_c(holder["state"], batch)
+        return m["loss"]
+
     for name, compiled, call in (
         ("fwd", fwd_c, lambda: fwd_c(state.params, state.extra_vars, batch, rng)),
         ("fwd_bwd", bwd_c,
          lambda: bwd_c(state.params, state.extra_vars, batch, rng)[0]),
-        ("full_step", step_c, None),
+        ("full_step", step_c, step_call),
     ):
-        if name == "full_step":
-            # the step donates its input state; rethread it every call
-            holder = {"state": state}
-
-            def call(h=holder):
-                h["state"], m = step_c(h["state"], batch)
-                return m["loss"]
-
         t = timed(call, iters=args.iters)
         c = cost_of(compiled)
         row = {
@@ -155,7 +154,6 @@ def main() -> None:
             # v5e) or the MXU were the only limit
             row["hbm_bound_ms"] = round(c["bytes"] / 819e9 * 1e3, 3)
             row["mxu_bound_ms"] = round(c["flops"] / peak * 1e3, 3)
-        rows.append(row)
         if name == "full_step":
             t_step = t
         print(json.dumps(row), flush=True)
