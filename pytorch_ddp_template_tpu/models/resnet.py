@@ -131,12 +131,19 @@ class ResNet(nn.Module):
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                # explicit name: nn.remat changes the class-derived scope
+                # name, so without this a remat toggle would silently
+                # re-key the whole param tree and orphan checkpoints.
+                # (One-time break: checkpoints written before these names
+                # existed — BasicBlock_N/BottleneckBlock_N keys — cannot
+                # be restored into this tree.)
                 x = block_cls(
                     filters=self.num_filters * 2**i,
                     conv=conv,
                     norm=norm,
                     act=act,
                     strides=strides,
+                    name=f"stage{i}_block{j}",
                 )(x)
 
         x = jnp.mean(x, axis=(1, 2))  # global average pool

@@ -50,6 +50,39 @@ class TestResNetModule:
             not np.allclose(a, b) for a, b in zip(before, after)
         ), "train-mode forward must advance running statistics"
 
+    def test_remat_matches_no_remat_forward_and_grad(self):
+        """Rematerialised blocks must be a pure scheduling change: identical
+        logits, identical gradients, and the BatchNorm mutable collection
+        still threads through the lifted transform (the failure mode
+        nn.remat can introduce silently)."""
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+        labels = jnp.array([0, 1, 2, 3])
+        base = ResNet18(num_classes=10, stem="cifar")
+        rem = ResNet18(num_classes=10, stem="cifar", remat=True)
+        variables = base.init(jax.random.PRNGKey(0), x, train=False)
+
+        def loss_fn(model):
+            def f(params):
+                logits, mutated = model.apply(
+                    {"params": params,
+                     "batch_stats": variables["batch_stats"]},
+                    x, train=True, mutable=["batch_stats"])
+                one_hot = jax.nn.one_hot(labels, 10)
+                loss = -jnp.mean(
+                    jnp.sum(jax.nn.log_softmax(logits) * one_hot, -1))
+                return loss, mutated["batch_stats"]
+            return jax.value_and_grad(f, has_aux=True)(variables["params"])
+
+        (l1, stats1), g1 = loss_fn(base)
+        (l2, stats2), g2 = loss_fn(rem)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5),
+            g1, g2)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6),
+            stats1, stats2)
+
     def test_bf16_compute_f32_logits(self):
         model = ResNet18(num_classes=10, stem="cifar", dtype=jnp.bfloat16)
         x = jnp.zeros((2, 32, 32, 3))

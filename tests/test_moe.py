@@ -13,6 +13,24 @@ from pytorch_ddp_template_tpu.models.moe import MoeMlpBlock
 from pytorch_ddp_template_tpu.runtime import make_mesh
 
 
+def make_trainer(tmp_path, mesh_spec, **over):
+    """gpt-moe-tiny Trainer on the given mesh (shared by every class here)."""
+    from pytorch_ddp_template_tpu.runtime import init
+    from pytorch_ddp_template_tpu.train import Trainer
+
+    kw = dict(
+        output_dir=str(tmp_path / "o"), model="gpt-moe-tiny",
+        mesh=mesh_spec, per_device_train_batch_size=4, dataset_size=256,
+        logging_steps=0, save_steps=0, max_steps=12,
+        learning_rate=1e-2, optimizer="adam",
+    )
+    kw.update(over)
+    cfg = TrainingConfig(**kw)
+    ctx = init(cfg)
+    task, ds = build(cfg.model, cfg, mesh=ctx.mesh)
+    return Trainer(cfg, ctx, task, ds)
+
+
 class TestMoeBlock:
     def test_dispatch_equals_dense_path(self):
         """Same params, same input: the all_to_all expert-parallel path and
@@ -34,25 +52,11 @@ class TestMoeBlock:
 
 
 class TestMoeTraining:
-    def _trainer(self, tmp_path, mesh_spec, **over):
-        from pytorch_ddp_template_tpu.runtime import init
-        from pytorch_ddp_template_tpu.train import Trainer
-
-        cfg = TrainingConfig(
-            output_dir=str(tmp_path / "o"), model="gpt-moe-tiny",
-            mesh=mesh_spec, per_device_train_batch_size=4, dataset_size=256,
-            logging_steps=0, save_steps=0, max_steps=12,
-            learning_rate=1e-2, optimizer="adam", **over,
-        )
-        ctx = init(cfg)
-        task, ds = build(cfg.model, cfg, mesh=ctx.mesh)
-        return Trainer(cfg, ctx, task, ds)
-
     def test_trains_on_expert_mesh(self, tmp_path):
         """Full engine over data:2,expert:4 (one expert per rank, so the
         all_to_all dispatch path is live in the hot loop) — sharded
         batches, expert-sharded weights; loss must descend."""
-        t = self._trainer(tmp_path, "data:2,expert:4")
+        t = make_trainer(tmp_path, "data:2,expert:4")
         state, _ = t.restore_or_init()
         losses = []
         for epoch in range(2):
@@ -63,7 +67,7 @@ class TestMoeTraining:
         assert sum(losses[-k:]) / k < sum(losses[:k]) / k, losses
 
     def test_expert_weights_sharded_over_expert_axis(self, tmp_path):
-        t = self._trainer(tmp_path, "data:2,expert:4")
+        t = make_trainer(tmp_path, "data:2,expert:4")
         state, _ = t.restore_or_init()
         flat = jax.tree_util.tree_flatten_with_path(state.params)[0]
         moe_leaves = [
@@ -100,17 +104,9 @@ class TestLoadBalanceLoss:
         """Training must carry the Switch load-balance term: present in
         metrics, >= 1 (its minimum, at uniform routing), and feeding the
         gate a balance gradient beyond the top-1 scale."""
-        from pytorch_ddp_template_tpu.runtime import init
-        from pytorch_ddp_template_tpu.train import Trainer
-
-        cfg = TrainingConfig(
-            output_dir=str(tmp_path / "o"), model="gpt-moe-tiny",
-            mesh="data:8", per_device_train_batch_size=1, dataset_size=64,
-            logging_steps=0, save_steps=0, max_steps=2,
-        )
-        ctx = init(cfg)
-        task, ds = build(cfg.model, cfg, mesh=ctx.mesh)
-        t = Trainer(cfg, ctx, task, ds)
+        t = make_trainer(tmp_path, "data:8", per_device_train_batch_size=1,
+                         dataset_size=64, max_steps=2,
+                         learning_rate=1e-3, optimizer="sgd")
         state, _ = t.restore_or_init()
         state, metrics = t.train_step(state, next(iter(t.loader.epoch(0))))
         aux = float(metrics["aux_loss"])
@@ -139,18 +135,9 @@ class TestZero1Composition:
         sharded MoE weights: one step must run and descend-capable state
         must remain finite — the two sharding passes touch the same
         opt-state tree and must not fight."""
-        from pytorch_ddp_template_tpu.runtime import init
-        from pytorch_ddp_template_tpu.train import Trainer
-
-        cfg = TrainingConfig(
-            output_dir=str(tmp_path / "o"), model="gpt-moe-tiny",
-            mesh="data:2,expert:4", per_device_train_batch_size=2,
-            dataset_size=64, logging_steps=0, save_steps=0, max_steps=2,
-            optimizer="adam", zero1=True,
-        )
-        ctx = init(cfg)
-        task, ds = build(cfg.model, cfg, mesh=ctx.mesh)
-        t = Trainer(cfg, ctx, task, ds)
+        t = make_trainer(tmp_path, "data:2,expert:4",
+                         per_device_train_batch_size=2, dataset_size=64,
+                         max_steps=2, learning_rate=1e-3, zero1=True)
         state, _ = t.restore_or_init()
         state, metrics = t.train_step(state, next(iter(t.loader.epoch(0))))
         assert np.isfinite(float(metrics["loss"]))

@@ -59,7 +59,9 @@ def main() -> None:
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     import bench
-    from bench import cost_of
+    from bench import cost_of, init_devices
+
+    init_devices()  # honours BENCH_CPU=1 and guards against a dead tunnel
     from pytorch_ddp_template_tpu.config import TrainingConfig
     from pytorch_ddp_template_tpu.models import build
     from pytorch_ddp_template_tpu.parallel import shard_tree
