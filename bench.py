@@ -222,6 +222,7 @@ def run_bench(model: str, metric: str, unit: str, baseline: float,
     devices = list(devices if devices is not None else jax.devices())
     n_dev = len(devices)
     mesh = make_mesh(f"data:{n_dev}", devices)
+    remat = os.environ.get("BENCH_REMAT", "") == "1"
     config = TrainingConfig(
         model=model,
         mesh=f"data:{n_dev}",
@@ -230,6 +231,7 @@ def run_bench(model: str, metric: str, unit: str, baseline: float,
         dataset_size=per_device * n_dev * 2,
         warmup_steps=0,
         max_grad_norm=1000.0,
+        remat=remat,  # bandwidth-for-flops ablation (tools/mfu_probe.py twin)
     )
     seed_key = jax.random.PRNGKey(0)
     ctx = RuntimeContext(mesh=mesh, seed_key=seed_key,
@@ -292,6 +294,8 @@ def run_bench(model: str, metric: str, unit: str, baseline: float,
         "global_batch": global_batch,
         "step_time_ms": round(1000 * dt / TIMED_STEPS, 2),
     }
+    if remat:
+        out["remat"] = True
     if step_flops is not None:
         kind = devices[0].device_kind
         peak = next((v for k, v in PEAK_FLOPS.items() if k in kind), None)

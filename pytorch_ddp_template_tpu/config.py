@@ -63,6 +63,8 @@ class TrainingConfig:
     mesh: str = "data:-1"  # mesh spec, e.g. "data:-1" or "data:4,model:2"
     cp_impl: str = "ring"  # context-parallel engine: ring | ulysses
     zero1: bool = False  # shard optimizer state over the data axis (ZeRO-1)
+    remat: bool = False  # rematerialise blocks (peak-memory for FLOPs trade;
+    #                      long-context entries default it on regardless)
     coordinator_address: str | None = None  # jax.distributed rendezvous
     num_processes: int | None = None
     process_id: int | None = None
@@ -161,6 +163,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--zero1", action="store_true",
                    help="Shard optimizer state over the data axis (ZeRO-1): "
                         "momentum/Adam memory divided by the DP degree.")
+    p.add_argument("--remat", action="store_true",
+                   help="Rematerialise model blocks in backward: peak "
+                        "activation memory for recompute FLOPs (measured a "
+                        "net loss on HBM-bound resnet50 — see BENCH.md — "
+                        "but unlocks otherwise-OOM batch/seq configs).")
     p.add_argument("--coordinator_address", type=str, default=None)
     p.add_argument("--num_processes", type=int, default=None)
     p.add_argument("--process_id", type=int, default=None)

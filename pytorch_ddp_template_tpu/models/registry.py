@@ -47,6 +47,13 @@ def build(name: str, config: TrainingConfig, mesh=None) -> tuple[Task, Dataset]:
         task, ds = factory(config, mesh=mesh)
     else:
         task, ds = factory(config)
+    if config.remat:
+        if not hasattr(task.model, "remat"):
+            raise ValueError(
+                f"--remat: model {name!r} ({type(task.model).__name__}) has "
+                "no remat knob"
+            )
+        task.model = task.model.clone(remat=True)
     if config.data_dir:
         from ..data.filestore import MemmapDataset
 

@@ -120,6 +120,20 @@ class ResNet(nn.Module):
             x = norm(name="bn_init")(x)
             x = act(x)
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        elif self.stem == "space_to_depth":
+            # MLPerf-style stem: fold 2x2 spatial blocks into channels
+            # (H,W,3 -> H/2,W/2,12) and swap the 7x7/s2 conv for 4x4/s1 —
+            # the same downsampling, but the conv input has 12 channels
+            # instead of 3, a shape the MXU tiles far less wastefully.
+            # Not weight-compatible with the imagenet stem (fresh stem
+            # params); the trunk is unchanged.
+            n, h, w, c = x.shape
+            x = x.reshape(n, h // 2, 2, w // 2, 2, c)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, w // 2, 4 * c)
+            x = conv(self.num_filters, (4, 4), (1, 1), name="conv_init")(x)
+            x = norm(name="bn_init")(x)
+            x = act(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         elif self.stem == "cifar":
             x = conv(self.num_filters, (3, 3), (1, 1), name="conv_init")(x)
             x = norm(name="bn_init")(x)

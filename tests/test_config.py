@@ -49,3 +49,15 @@ def test_train_batch_size_scales_with_devices(devices):
 def test_unknown_flag_rejected():
     with pytest.raises(SystemExit):
         parse_args(["--definitely_not_a_flag"])
+
+
+def test_remat_flag_reaches_model():
+    from pytorch_ddp_template_tpu.models import build
+
+    cfg = parse_args(["--remat", "--model", "resnet18"])
+    assert cfg.remat is True
+    task, _ = build(cfg.model, cfg)
+    assert task.model.remat is True
+    # models without the knob fail loudly, not silently un-rematerialised
+    with pytest.raises(ValueError, match="remat"):
+        build("mlp", parse_args(["--remat", "--model", "mlp"]))

@@ -50,6 +50,22 @@ class TestResNetModule:
             not np.allclose(a, b) for a, b in zip(before, after)
         ), "train-mode forward must advance running statistics"
 
+    def test_space_to_depth_stem_matches_imagenet_geometry(self):
+        """The s2d stem must reproduce the imagenet stem's downsampling
+        (same trunk input resolution) with 12-channel conv input."""
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 64, 64, 3))
+        base = ResNet50(num_classes=10)
+        s2d = ResNet50(num_classes=10, stem="space_to_depth")
+        vb = base.init(jax.random.PRNGKey(1), x, train=False)
+        vs = s2d.init(jax.random.PRNGKey(1), x, train=False)
+        assert s2d.apply(vs, x, train=False).shape == (2, 10)
+        # stem kernel is 4x4x12 in, trunk params are shape-identical
+        assert vs["params"]["conv_init"]["kernel"].shape == (4, 4, 12, 64)
+        assert vb["params"]["conv_init"]["kernel"].shape == (7, 7, 3, 64)
+        trunk_b = {k: v for k, v in vb["params"].items() if "block" in k}
+        trunk_s = {k: v for k, v in vs["params"].items() if "block" in k}
+        assert jax.tree.structure(trunk_b) == jax.tree.structure(trunk_s)
+
     def test_remat_matches_no_remat_forward_and_grad(self):
         """Rematerialised blocks must be a pure scheduling change: identical
         logits, identical gradients, and the BatchNorm mutable collection

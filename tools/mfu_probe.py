@@ -46,10 +46,14 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="resnet50")
     ap.add_argument("--batch", type=int, default=0, help="0 = bench default")
-    ap.add_argument("--norm-dtype", default=None, choices=[None, "f32", "bf16"],
+    ap.add_argument("--norm-dtype", default=None, choices=["f32", "bf16"],
                     help="ResNet BatchNorm compute-dtype ablation")
     ap.add_argument("--remat", action="store_true",
                     help="rematerialise residual blocks (ResNet ablation)")
+    ap.add_argument("--stem", default=None,
+                    choices=["imagenet", "space_to_depth"],
+                    help="ResNet stem ablation (space_to_depth folds 2x2 "
+                         "pixels into channels before the first conv)")
     ap.add_argument("--iters", type=int, default=20)
     args = ap.parse_args()
 
@@ -87,6 +91,8 @@ def main() -> None:
         task.model = task.model.clone(norm_dtype=nd)
     if args.remat:
         task.model = task.model.clone(remat=True)
+    if args.stem:
+        task.model = task.model.clone(stem=args.stem)
 
     global_batch = per_device * n_dev
     idx = np.arange(global_batch) % len(dataset)
@@ -142,6 +148,7 @@ def main() -> None:
         row = {
             "probe": name, "model": args.model, "batch": global_batch,
             "norm_dtype": args.norm_dtype or "f32", "remat": args.remat,
+            **({"stem": args.stem} if args.stem else {}),
             "time_ms": round(t * 1e3, 3),
             "gflops": round(c["flops"] / 1e9, 2),
             "gbytes": round(c["bytes"] / 1e9, 3),
