@@ -63,6 +63,8 @@ class TrainingConfig:
     mesh: str = "data:-1"  # mesh spec, e.g. "data:-1" or "data:4,model:2"
     cp_impl: str = "ring"  # context-parallel engine: ring | ulysses
     zero1: bool = False  # shard optimizer state over the data axis (ZeRO-1)
+    fsdp: bool = False  # shard params+grads+opt state over data (FSDP/ZeRO-3;
+    #                     subsumes zero1)
     remat: bool = False  # rematerialise blocks (peak-memory for FLOPs trade;
     #                      long-context entries default it on regardless)
     coordinator_address: str | None = None  # jax.distributed rendezvous
@@ -163,6 +165,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--zero1", action="store_true",
                    help="Shard optimizer state over the data axis (ZeRO-1): "
                         "momentum/Adam memory divided by the DP degree.")
+    p.add_argument("--fsdp", action="store_true",
+                   help="Shard params, grads and optimizer state over the "
+                        "data axis (FSDP/ZeRO-3): per-chip model memory "
+                        "divided by the DP degree; GSPMD inserts the "
+                        "gather/scatter protocol. Subsumes --zero1.")
     p.add_argument("--remat", action="store_true",
                    help="Rematerialise model blocks in backward: peak "
                         "activation memory for recompute FLOPs (measured a "

@@ -18,6 +18,7 @@ from .sharding import (
     DEFAULT_RULES,
     active_rules,
     describe,
+    fsdp_reshard,
     logical_shardings,
     shard_tree,
     zero1_reshard,
@@ -29,6 +30,7 @@ __all__ = [
     "active_rules",
     "describe",
     "expert_apply",
+    "fsdp_reshard",
     "logical_shardings",
     "stack_expert_params",
     "pipeline_apply",

@@ -79,27 +79,20 @@ def shard_tree(tree: Any, mesh: Mesh,
     return jax.device_put(nn.meta.unbox(tree), shardings)
 
 
-def zero1_reshard(opt_state: Any, mesh: Mesh) -> Any:
-    """ZeRO-1: shard optimizer state over the ``data`` axis.
+def _shard_free_dim_over_data(tree: Any, mesh: Mesh) -> Any:
+    """Shard each leaf's first dividable free dim over ``data``.
 
-    The reference replicates optimizer state on every rank (``optim.SGD``
-    over all params, ``/root/reference/ddp.py:183``; SURVEY.md §2b marks
-    ZeRO "No"). Here each leaf already placed on the mesh (param-mirrored
-    shardings under TP) gets its first free dim that the data-axis size
-    divides additionally sharded over ``data`` — cutting momentum/Adam
-    state memory by the DP degree. Inside the jitted step GSPMD partitions
-    the optimizer update over ``data`` and inserts the all-gather of
-    updates onto the replicated params: ZeRO-1 semantics without a wire
-    protocol, the same way sharding-induced psum replaced DDP.
-
-    Leaves with no dividable free dim (scalars, odd shapes) stay as they
-    are — correctness never depends on a leaf being sharded.
+    Leaves already placed on the mesh (param-mirrored shardings under TP)
+    keep their existing axes; ``data`` is only added to a dim that is
+    unsharded and whose size the data-axis size divides. Leaves with no
+    such dim (scalars, odd shapes) stay as they are — correctness never
+    depends on a leaf being sharded.
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     data_size = mesh.shape.get(DATA_AXIS, 1)
     if data_size == 1:
-        return opt_state
+        return tree
 
     def widen(x):
         if not hasattr(x, "sharding") or x.ndim == 0:
@@ -118,7 +111,37 @@ def zero1_reshard(opt_state: Any, mesh: Mesh) -> Any:
                 return jax.device_put(x, NamedSharding(mesh, P(*spec)))
         return x
 
-    return jax.tree.map(widen, opt_state)
+    return jax.tree.map(widen, tree)
+
+
+def zero1_reshard(opt_state: Any, mesh: Mesh) -> Any:
+    """ZeRO-1: shard optimizer state over the ``data`` axis.
+
+    The reference replicates optimizer state on every rank (``optim.SGD``
+    over all params, ``/root/reference/ddp.py:183``; SURVEY.md §2b marks
+    ZeRO "No"). Here momentum/Adam state memory is cut by the DP degree.
+    Inside the jitted step GSPMD partitions the optimizer update over
+    ``data`` and inserts the all-gather of updates onto the replicated
+    params: ZeRO-1 semantics without a wire protocol, the same way
+    sharding-induced psum replaced DDP.
+    """
+    return _shard_free_dim_over_data(opt_state, mesh)
+
+
+def fsdp_reshard(tree: Any, mesh: Mesh) -> Any:
+    """FSDP / ZeRO-3: shard params (and their optimizer mirrors) over
+    ``data``.
+
+    Applied to *params* as well as optimizer state, this is the full
+    ZeRO-3 memory split: every rank holds 1/DP of the weights, gradients
+    and optimizer state. GSPMD supplies the runtime protocol from the
+    shardings alone — the forward all-gathers each weight just before
+    use, the backward reduce-scatters gradients straight into the shard
+    layout, and the optimizer update runs shard-local. The reference has
+    no analogue (SURVEY.md §2b: ZeRO/FSDP "No"); PyTorch needs a wrapper
+    module and hand-scheduled gather/scatter hooks for the same semantics.
+    """
+    return _shard_free_dim_over_data(tree, mesh)
 
 
 def describe(mesh: Mesh) -> dict[str, Any]:

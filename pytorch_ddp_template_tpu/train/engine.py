@@ -252,10 +252,19 @@ class Trainer:
         # DDP-construction param broadcast (ddp.py:194-195) as a sharding —
         # replicated for plain-DDP models, split over ``model`` for
         # tensor-parallel meshes (parallel/sharding.py rules).
-        from ..parallel.sharding import shard_tree, zero1_reshard
+        from ..parallel.sharding import (
+            fsdp_reshard, shard_tree, zero1_reshard,
+        )
 
         state = shard_tree(state, self.ctx.mesh)
-        if self.config.zero1:
+        if self.config.fsdp:
+            # full ZeRO-3 split: weights, grads (via GSPMD propagation)
+            # and optimizer mirrors all live sharded over ``data``
+            state = state.replace(
+                params=fsdp_reshard(state.params, self.ctx.mesh),
+                opt_state=fsdp_reshard(state.opt_state, self.ctx.mesh),
+            )
+        elif self.config.zero1:
             state = state.replace(
                 opt_state=zero1_reshard(state.opt_state, self.ctx.mesh)
             )

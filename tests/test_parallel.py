@@ -312,6 +312,109 @@ def test_zero1_shards_opt_state_and_preserves_numerics(tmp_path):
     assert abs(loss_rep - loss_z1) < 1e-6, (loss_rep, loss_z1)
 
 
+def test_fsdp_shards_params_and_preserves_numerics(tmp_path):
+    """FSDP/ZeRO-3: params AND optimizer state sharded over data; loss
+    trajectory identical to replicated DDP (GSPMD's gather/scatter
+    protocol changes placement, not math)."""
+    from pytorch_ddp_template_tpu.train.engine import Trainer
+
+    def run(fsdp, out):
+        cfg = TrainingConfig(
+            model="mlp-wide", optimizer="momentum", fsdp=fsdp,
+            dataset_size=256, per_device_train_batch_size=4, max_steps=4,
+            logging_steps=0, save_steps=0, output_dir=out, resume=False,
+            mesh="data:8", max_grad_norm=1.0, seed=11,
+        )
+        mesh = make_mesh(cfg.mesh, jax.devices())
+        task, ds = build(cfg.model, cfg)
+        trainer = Trainer(cfg, _ctx(mesh, cfg), task, ds)
+        state = trainer.restore_or_init()[0]
+        batch = next(iter(trainer.loader.epoch(0)))
+        for _ in range(4):
+            state, metrics = trainer.train_step(state, batch)
+        # specs AFTER jitted steps: the memory split must survive GSPMD
+        # propagation through the whole update, not just init
+        pspecs = [str(x.sharding.spec) for x in jax.tree.leaves(state.params)
+                  if hasattr(x, "sharding") and x.ndim >= 1]
+        ospecs = [str(x.sharding.spec)
+                  for x in jax.tree.leaves(state.opt_state)
+                  if hasattr(x, "sharding") and x.ndim >= 1]
+        return pspecs, ospecs, float(metrics["loss"])
+
+    p_rep, o_rep, loss_rep = run(False, str(tmp_path / "a"))
+    p_f, o_f, loss_f = run(True, str(tmp_path / "b"))
+    assert not any("data" in s for s in p_rep)
+    assert any("data" in s for s in p_f), p_f
+    assert any("data" in s for s in o_f), o_f
+    assert abs(loss_rep - loss_f) < 1e-6, (loss_rep, loss_f)
+
+
+def test_fsdp_composes_with_tensor_parallel(tmp_path):
+    """data×model mesh + fsdp: TP placement keeps its model axis, the
+    free dims pick up data — and the composed step still trains."""
+    from pytorch_ddp_template_tpu.train.engine import Trainer
+
+    cfg = TrainingConfig(
+        model="bert-tiny", optimizer="adam", fsdp=True,
+        mesh="data:4,model:2", dataset_size=64,
+        per_device_train_batch_size=2, max_steps=2, logging_steps=0,
+        save_steps=0, output_dir=str(tmp_path / "o"), resume=False,
+    )
+    mesh = make_mesh(cfg.mesh, jax.devices())
+    task, ds = build(cfg.model, cfg)
+    trainer = Trainer(cfg, _ctx(mesh, cfg), task, ds)
+    state = trainer.restore_or_init()[0]
+    leaves = [x for x in jax.tree.leaves(state.params)
+              if hasattr(x, "sharding") and x.ndim >= 1]
+    assert any("model" in str(x.sharding.spec) for x in leaves)
+    assert any("data" in str(x.sharding.spec) for x in leaves)
+    state, metrics = trainer.train_step(
+        state, next(iter(trainer.loader.epoch(0))))
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_fsdp_checkpoint_resume_roundtrip(tmp_path):
+    """FSDP-sharded state must survive orbax save → restore: the restore
+    re-places every distributed array with the fsdp shardings and training
+    resumes bit-identically (sharded checkpoints are where naive
+    save/restore paths classically break)."""
+    from pytorch_ddp_template_tpu.train.engine import Trainer
+
+    def make(out, max_steps):
+        cfg = TrainingConfig(
+            model="mlp-wide", optimizer="momentum", fsdp=True,
+            dataset_size=128, per_device_train_batch_size=2,
+            max_steps=max_steps, logging_steps=0, save_steps=2,
+            output_dir=out, mesh="data:8", seed=3, learning_rate=1e-2,
+        )
+        mesh = make_mesh(cfg.mesh, jax.devices())
+        task, ds = build(cfg.model, cfg)
+        return Trainer(cfg, _ctx(mesh, cfg), task, ds)
+
+    out_a, out_b = str(tmp_path / "a"), str(tmp_path / "b")
+    final_a = make(out_a, 4).train()  # uninterrupted 4 steps
+
+    # segment 1: same schedule (max_steps=4), interrupted after 2 steps
+    t1 = make(out_b, 4)
+    state1, _ = t1.restore_or_init()
+    it = iter(t1.loader.epoch(0))
+    for _ in range(2):
+        state1, _ = t1.train_step(state1, next(it))
+    t1.ckpt.save(2, state1, t1.config)
+    t1.ckpt.wait()
+
+    t = make(out_b, 4)      # segment 2: must restore step 2, run to 4
+    state, start = t.restore_or_init()
+    assert start == 2
+    assert any("data" in str(x.sharding.spec)
+               for x in jax.tree.leaves(state.params)
+               if hasattr(x, "sharding") and x.ndim >= 1)
+    final_b = t.train()
+    for a, b in zip(jax.tree.leaves(jax.device_get(final_a.params)),
+                    jax.tree.leaves(jax.device_get(final_b.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_zero1_composes_with_tensor_parallel():
     """On a data×model mesh, zero1 adds `data` to free dims of opt-state
     leaves without disturbing the model-axis param mirror."""
