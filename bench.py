@@ -483,6 +483,38 @@ def run_flash(seq: int | None = None) -> dict:
         results[f"{key}_xla_ms"] = round(t_xla * 1e3, 3)
         results[f"{key}_speedup"] = round(t_xla / t_flash, 3)
 
+        # training path: fwd+bwd through the custom-vjp Pallas backward
+        # kernels vs XLA autodiff (grad numerics asserted, then timed)
+        def grad_of(fn):
+            return jax.jit(jax.grad(
+                lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2),
+                argnums=(0, 1, 2)))
+
+        gflash, gxla = grad_of(flash), grad_of(xla)
+        gf, gx = gflash(q, k, v), gxla(q, k, v)
+        for a, b_ in zip(gf, gx):
+            gerr = float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                         - b_.astype(jnp.float32))))
+            gscale = float(jnp.max(jnp.abs(b_.astype(jnp.float32))))
+            if gerr > max(tol * 50, tol * gscale):
+                raise AssertionError(
+                    f"flash grad mismatch (causal={causal}): max err {gerr} "
+                    f"(ref scale {gscale})"
+                )
+
+        def timed_grad(fn, iters=20):
+            jax.block_until_ready(fn(q, k, v))  # compile
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(q, k, v)
+            jax.block_until_ready(out)
+            return (time.perf_counter() - t0) / iters
+
+        tb_flash, tb_xla = timed_grad(gflash), timed_grad(gxla)
+        results[f"{key}_bwd_flash_ms"] = round(tb_flash * 1e3, 3)
+        results[f"{key}_bwd_xla_ms"] = round(tb_xla * 1e3, 3)
+        results[f"{key}_bwd_speedup"] = round(tb_xla / tb_flash, 3)
+
     speedup = results["causal_speedup"]
     return {
         "metric": f"flash_attn_speedup_seq{seq}_causal",

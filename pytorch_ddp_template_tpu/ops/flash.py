@@ -18,9 +18,11 @@ Design (FlashAttention recurrence, TPU-shaped):
 - Causal blocks that are fully masked are skipped (work scales with the
   triangle, not the square); the final kv iteration writes
   ``out = acc / l`` and the logsumexp.
-- Backward: ``custom_vjp`` with the saved logsumexp; recomputes logits
-  blockwise with a ``lax.scan`` (O(block) memory) and applies the standard
-  flash backward formulas — no O(seq^2) residuals anywhere.
+- Backward: ``custom_vjp`` with the saved logsumexp; two Pallas kernels
+  (dq over kv-sequential blocks; dk+dv over q-sequential blocks) recompute
+  logits tilewise and apply the standard flash backward formulas — no
+  O(seq^2) residuals anywhere, causally dead block pairs skipped with
+  their DMA redirected (the public JAX flash kernel's trick).
 
 ``interpret=True`` (automatic off-TPU) runs the same kernel through the
 Pallas interpreter, which is how CPU CI validates numerics.
@@ -132,43 +134,200 @@ def _fwd_pallas(q, k, v, *, causal: bool, block_q: int, block_kv: int,
     return out, lse[..., 0]
 
 
-def _bwd_blockwise(res, do, *, causal: bool, block_kv: int):
-    """Flash backward via lax.scan over kv blocks (O(block) memory)."""
+def _block_logits(q_ref, k_ref, *, scale, causal, i, j, block_q, block_kv):
+    """Scaled (and causally masked) logits for one (q, kv) block pair,
+    plus the f32 q tile (scale folded in — the dk formula reuses it)."""
+    qf = q_ref[0, 0].astype(jnp.float32) * scale              # (bq, d)
+    kf = k_ref[0, 0].astype(jnp.float32)                      # (bkv, d)
+    s = lax.dot_general(qf, kf, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32)   # (bq, bkv)
+    if causal:
+        q_pos = i * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 0)
+        k_pos = j * block_kv + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    return s, qf
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   acc_ref, *, scale: float, causal: bool, block_q: int,
+                   block_kv: int, kv_blocks: int):
+    i = pl.program_id(2)  # q block
+    j = pl.program_id(3)  # kv block (sequential)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    needed = (j * block_kv <= (i + 1) * block_q - 1) if causal else True
+
+    @pl.when(needed)
+    def _compute():
+        s, _ = _block_logits(q_ref, k_ref, scale=scale, causal=causal,
+                             i=i, j=j, block_q=block_q, block_kv=block_kv)
+        # per-row scalars arrive compact (1, block_q) along lanes; the
+        # reshape to a (block_q, 1) column is one in-VMEM relayout — far
+        # cheaper than streaming a 128x lane-replicated HBM tensor
+        lse = lse_ref[0, 0].reshape(block_q, 1)
+        delta = delta_ref[0, 0].reshape(block_q, 1)
+        p = jnp.exp(s - lse)                                  # (bq, bkv)
+        do = do_ref[0, 0].astype(jnp.float32)                 # (bq, d)
+        v = v_ref[0, 0].astype(jnp.float32)                   # (bkv, d)
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)                                 # (bq, bkv)
+        k = k_ref[0, 0].astype(jnp.float32)
+        acc_ref[...] += lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(j == kv_blocks - 1)
+    def _finalize():
+        dq_ref[0, 0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+                    causal: bool, block_q: int, block_kv: int, q_blocks: int):
+    j = pl.program_id(2)  # kv block
+    i = pl.program_id(3)  # q block (sequential)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    needed = ((i + 1) * block_q - 1 >= j * block_kv) if causal else True
+
+    @pl.when(needed)
+    def _compute():
+        s, qf = _block_logits(q_ref, k_ref, scale=scale, causal=causal,
+                              i=i, j=j, block_q=block_q, block_kv=block_kv)
+        lse = lse_ref[0, 0].reshape(block_q, 1)
+        delta = delta_ref[0, 0].reshape(block_q, 1)
+        p = jnp.exp(s - lse)                                  # (bq, bkv)
+        do = do_ref[0, 0].astype(jnp.float32)                 # (bq, d)
+        dv_acc[...] += lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),                  # p^T @ do
+            preferred_element_type=jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)                                 # (bq, bkv)
+        dk_acc[...] += lax.dot_general(
+            ds, qf, (((0,), (0,)), ((), ())),                 # ds^T @ qf
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == q_blocks - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd_pallas(res, do, *, causal: bool, block_q: int, block_kv: int,
+                interpret: bool):
+    """Flash backward as two Pallas kernels (dq; dk+dv).
+
+    Same tiling discipline as the forward: causally dead block pairs are
+    skipped (work scales with the triangle) and, following the public JAX
+    flash kernel's trick, a skipped step's DMA is redirected to block 0 so
+    it costs no fresh HBM read. lse/delta stay compact ``(B, H, S)`` in
+    HBM (blocked along lanes; one in-VMEM column reshape per tile).
+    """
     q, k, v, out, lse = res  # q,k,v,out: (B,H,S,D); lse: (B,H,S)
     b, h, s, d = q.shape
     t = k.shape[2]
-    block = min(block_kv, t)
-    n = t // block
     scale = d ** -0.5
+    # mirror the forward's clamp + guard: the nondiff block args arrive
+    # unclamped, and a silently truncated grid would return garbage grads
+    block_q = min(block_q, s)
+    block_kv = min(block_kv, t)
+    if s % block_q or t % block_kv:
+        raise ValueError(
+            f"seq {s}/{t} not divisible by blocks {block_q}/{block_kv}")
+    q_blocks, kv_blocks = s // block_q, t // block_kv
 
-    qf = q.astype(jnp.float32) * scale
     dof = do.astype(jnp.float32)
-    # delta_i = sum_d do_i * out_i  (rowwise), standard flash-bwd shortcut
-    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1)  # (B,H,S)
+    # delta_i = sum_d do_i * out_i (rowwise), standard flash-bwd shortcut;
+    # lse/delta stay compact (B,H,S) — blocked along lanes, reshaped to a
+    # column in-kernel — instead of a 128x lane-replicated HBM tensor
+    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1)   # (B,H,S)
 
-    kb = jnp.moveaxis(k.astype(jnp.float32).reshape(b, h, n, block, d), 2, 0)
-    vb = jnp.moveaxis(v.astype(jnp.float32).reshape(b, h, n, block, d), 2, 0)
+    def on_diag(i, j):
+        # the fwd/bwd skip predicate: q block i sees kv block j
+        return (i + 1) * block_q - 1 >= j * block_kv
 
-    def body(dq_acc, inp):
-        idx, kblk, vblk = inp  # kblk/vblk: (B,H,block,D)
-        logits = jnp.einsum("bhsd,bhtd->bhst", qf, kblk)
-        if causal:
-            q_pos = lax.broadcasted_iota(jnp.int32, (s, block), 0)
-            k_pos = idx * block + lax.broadcasted_iota(jnp.int32, (s, block), 1)
-            logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
-        p = jnp.exp(logits - lse[..., None])                  # (B,H,S,block)
-        dv = jnp.einsum("bhst,bhsd->bhtd", p, dof)
-        dp = jnp.einsum("bhsd,bhtd->bhst", dof, vblk)
-        ds = p * (dp - delta[..., None])                      # (B,H,S,block)
-        dq_acc = dq_acc + jnp.einsum("bhst,bhtd->bhsd", ds, kblk) * scale
-        dk = jnp.einsum("bhst,bhsd->bhtd", ds, qf)            # scale in qf
-        return dq_acc, (dk, dv)
+    # dq: grid over q blocks, kv sequential (mirrors the forward); a
+    # causally skipped step's DMA is redirected to block 0 so it costs no
+    # fresh HBM read (the public JAX flash kernel's trick)
+    def kv_map(b_, h_, i, j):
+        jj = lax.select(on_diag(i, j), j, 0) if causal else j
+        return (b_, h_, jj, 0)
 
-    dq0 = jnp.zeros((b, h, s, d), jnp.float32)
-    dq, (dks, dvs) = lax.scan(body, dq0, (jnp.arange(n), kb, vb))
-    dk = jnp.moveaxis(dks, 0, 2).reshape(b, h, t, d)
-    dv = jnp.moveaxis(dvs, 0, 2).reshape(b, h, t, d)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    qspec = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0))
+    lspec = pl.BlockSpec((1, 1, block_q), lambda b_, h_, i, j: (b_, h_, i))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_kv=block_kv,
+                          kv_blocks=kv_blocks),
+        grid=(b, h, q_blocks, kv_blocks),
+        in_specs=[
+            qspec,
+            pl.BlockSpec((1, 1, block_kv, d), kv_map),
+            pl.BlockSpec((1, 1, block_kv, d), kv_map),
+            qspec,
+            lspec,
+            lspec,
+        ],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # dk/dv: grid over kv blocks, q sequential; skipped q steps re-read
+    # block 0 of q/do/lse/delta instead of streaming dead tiles
+    def q_map(b_, h_, j, i):
+        ii = lax.select(on_diag(i, j), i, 0) if causal else i
+        return (b_, h_, ii, 0)
+
+    def l_map(b_, h_, j, i):
+        return q_map(b_, h_, j, i)[:3]
+
+    kvspec = pl.BlockSpec((1, 1, block_kv, d),
+                          lambda b_, h_, j, i: (b_, h_, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_kv=block_kv,
+                          q_blocks=q_blocks),
+        grid=(b, h, kv_blocks, q_blocks),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), q_map),
+            kvspec,
+            kvspec,
+            pl.BlockSpec((1, 1, block_q, d), q_map),
+            pl.BlockSpec((1, 1, block_q), l_map),
+            pl.BlockSpec((1, 1, block_q), l_map),
+        ],
+        out_specs=[kvspec, kvspec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, t, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, t, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_kv, d), jnp.float32),
+            pltpu.VMEM((block_kv, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -185,7 +344,8 @@ def _flash_fwd(q, k, v, causal, block_q, block_kv, interpret):
 
 
 def _flash_bwd(causal, block_q, block_kv, interpret, res, do):
-    return _bwd_blockwise(res, do, causal=causal, block_kv=block_kv)
+    return _bwd_pallas(res, do, causal=causal, block_q=block_q,
+                       block_kv=block_kv, interpret=interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

@@ -61,6 +61,73 @@ def test_flash_gradients_match(qkv):
         np.testing.assert_allclose(a, b, atol=1e-5 * max(scale, 1.0))
 
 
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("block", [16, 32])
+def test_flash_backward_kernel_all_shapes(qkv, causal, block):
+    """The Pallas backward (dq and dk/dv kernels) across block counts;
+    causal=True exercises the skip + DMA-redirect paths (equal blocks —
+    the gcd wrapper always tiles self-attention that way; unequal blocks
+    are covered by the cross-attention test below)."""
+    q, k, v = qkv
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
+
+    g_ref = jax.grad(loss(
+        lambda q, k, v: dot_product_attention(q, k, v, causal=causal)),
+        argnums=(0, 1, 2))(q, k, v)
+    g_fl = jax.grad(loss(
+        lambda q, k, v: flash_attention(q, k, v, causal=causal,
+                                        block_size=block)),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_fl):
+        scale = float(jnp.abs(a).max())
+        np.testing.assert_allclose(a, b, atol=1e-5 * max(scale, 1.0))
+
+
+def test_flash_backward_unequal_blocks_cross_attention():
+    """q len 64 / kv len 48 with block_size 32 tiles as block_q=32,
+    block_kv=16 — the mixed-block on_diag predicate and grid shapes the
+    equal-block tests can never reach."""
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.standard_normal((1, 64, 2, 32)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 48, 2, 32)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 48, 2, 32)), jnp.float32)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
+
+    g_ref = jax.grad(loss(lambda q, k, v: dot_product_attention(q, k, v)),
+                     argnums=(0, 1, 2))(q, k, v)
+    g_fl = jax.grad(loss(lambda q, k, v: flash_attention(q, k, v,
+                                                         block_size=32)),
+                    argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_fl):
+        scale = float(jnp.abs(a).max())
+        np.testing.assert_allclose(a, b, atol=1e-5 * max(scale, 1.0))
+
+
+def test_flash_backward_bf16(qkv):
+    """bf16 inputs: grads come back bf16 with f32 accumulation inside."""
+    q, k, v = (x.astype(jnp.bfloat16) for x in qkv)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       block_size=32).astype(jnp.float32) ** 2)
+
+    grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    ref = jax.grad(lambda q, k, v: jnp.sum(dot_product_attention(
+        q.astype(jnp.float32), k.astype(jnp.float32),
+        v.astype(jnp.float32), causal=True) ** 2), argnums=(0, 1, 2))(
+        q, k, v)
+    for g, r in zip(grads, ref):
+        assert g.dtype == jnp.bfloat16
+        scale = float(jnp.abs(r).max())
+        np.testing.assert_allclose(np.asarray(g, np.float32),
+                                   np.asarray(r, np.float32),
+                                   atol=0.05 * max(scale, 1.0))
+
+
 def test_padding_mask_blockwise(qkv):
     q, k, v = qkv
     keep = jnp.arange(S) < S // 2  # mask out the second half of kv

@@ -17,6 +17,12 @@ run() { # name, env..., — logs one JSON line or the error
 #    (bert-base regime; policy currently routes 512 to XLA, unmeasured)
 run flash512 BENCH_MODE=flash BENCH_SEQ=512
 
+# 1b. re-record flash at 1024/2048/4096: the mode now also times the
+#     Pallas backward kernels (bwd_* columns), absent from flash_tpu_r4
+run flash1024 BENCH_MODE=flash BENCH_SEQ=1024
+run flash2048 BENCH_MODE=flash BENCH_SEQ=2048
+run flash4096 BENCH_MODE=flash BENCH_SEQ=4096
+
 # 2. bert-base train under the current dispatch policy (XLA at 512) —
 #    compare with the pre-policy record 208.08 seq/s (train_tpu_r4.jsonl)
 run bert BENCH_MODE=train BENCH_MODEL=bert-base
