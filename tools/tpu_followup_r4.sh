@@ -33,4 +33,8 @@ run e2e_rn50 BENCH_MODE=e2e BENCH_MODEL=resnet50
 # 4. long-context single chip: gpt-long trains with flash at 4096 in situ
 run gpt_long BENCH_MODE=train BENCH_MODEL=gpt-long BENCH_BATCH=1 BENCH_STEPS=10
 
+# 5. gpt-small re-measure: its seq-1024 training step now runs the Pallas
+#    flash BACKWARD kernels too (record to compare vs 91.9 seq/s pre-bwd)
+run gpt_small BENCH_MODE=train BENCH_MODEL=gpt-small
+
 echo "done; records in $R/followup_tpu_r4.jsonl" >&2
