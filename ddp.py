@@ -69,9 +69,38 @@ def main(argv: list[str] | None = None) -> int:
             # a dedicated held-out store (e.g. the CIFAR-10 test split)
             # beats a tail holdout of the training store
             eval_ds = MemmapDataset(config.eval_data_dir)
-        elif config.eval_steps:
+        elif config.eval_steps or config.eval_only:
             dataset, eval_ds = train_eval_split(config, dataset)
         trainer = Trainer(config, ctx, task, dataset, eval_dataset=eval_ds)
+        if config.eval_only:
+            # evaluate a saved model, no training (the reference cannot do
+            # this at all: its checkpoints have no load path, ddp.py:293)
+            if trainer.ckpt.latest_step() is None:
+                raise FileNotFoundError(
+                    f"--eval_only: no checkpoints under {config.output_dir} "
+                    "(evaluating a fresh init is almost never intended; "
+                    "train first or point --output_dir at a run)"
+                )
+            if not config.resume and config.global_step == 0:
+                # restore_or_init would hand back the fresh init — garbage
+                # metrics under the checkpoint's name
+                raise ValueError(
+                    "--eval_only with --no_resume would evaluate random "
+                    "init; drop --no_resume or pin --global-step"
+                )
+            state, step = trainer.restore_or_init()
+            results = trainer.evaluate(state)
+            log.info("eval_only", {"step": step, **results})
+            from pytorch_ddp_template_tpu.utils import is_main_process
+
+            if is_main_process():
+                import json
+                from pathlib import Path
+
+                out = Path(config.output_dir) / f"eval_{step}.json"
+                out.write_text(json.dumps({"step": step, **results},
+                                          indent=2))
+            return 0
         state = trainer.train()
         if eval_ds is not None:
             final = trainer.evaluate(state)

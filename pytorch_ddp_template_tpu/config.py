@@ -77,6 +77,7 @@ class TrainingConfig:
     #                                   split); None = tail-holdout of data_dir
     augment: str = "none"  # on-device augmentation: none | flip | crop-flip
     eval_steps: int = 0  # 0 disables; reference evaluate() is a stub (ddp.py:123-124)
+    eval_only: bool = False  # evaluate a checkpoint (no training); needs one
     resume: bool = True  # auto-resume from latest checkpoint in output_dir
     profile_steps: int = 0  # trace steps [10, 10+N) to output_dir/profile (SURVEY.md §5.1)
     divergence_check_steps: int = 0  # cross-host param fingerprint every N steps (§5.2)
@@ -190,6 +191,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    choices=["none", "flip", "crop-flip"],
                    help="On-device image augmentation inside the jitted step.")
     p.add_argument("--eval_steps", type=int, default=0)
+    p.add_argument("--eval_only", action="store_true",
+                   help="Run the exactly-once eval on a saved checkpoint "
+                        "(latest, or --global-step) and exit — no training.")
     p.add_argument("--no_resume", dest="resume", action="store_false")
     p.add_argument("--profile_steps", type=int, default=0,
                    help="Capture a profiler trace over N steps (from step 10).")

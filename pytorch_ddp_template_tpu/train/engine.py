@@ -22,6 +22,8 @@ ddp.py:126-288``), redesigned for XLA rather than translated:
 
 from __future__ import annotations
 
+import signal
+import threading
 import time
 from typing import Any, Callable
 
@@ -362,7 +364,6 @@ class Trainer:
             except ImportError:
                 pbar = None
 
-        global_step = start_step
         window: list[jax.Array] = []
         side_work = False  # True when the last iteration ran eval/save/etc.
         trace = TraceWindow(cfg.output_dir, start_step=start_step + 10,
@@ -371,8 +372,40 @@ class Trainer:
         t_last = time.perf_counter()
         examples_per_step = cfg.train_batch_size * cfg.gradient_accumulation_steps
         start_epoch = start_step // self.steps_per_epoch
-        done = False
 
+        # graceful preemption (SLURM/TPU-VM maintenance send SIGTERM):
+        # finish the in-flight step, checkpoint, exit cleanly — the next
+        # run auto-resumes. The reference's pre-elastic launcher just dies
+        # (SURVEY.md §5.3). Only the main thread may own signal handlers.
+        stop_signal: dict[str, int | None] = {"sig": None}
+        handler_registered = False
+        prev_handler = None
+        if threading.current_thread() is threading.main_thread():
+            def _request_stop(signum, frame):  # noqa: ARG001
+                stop_signal["sig"] = signum
+            prev_handler = signal.signal(signal.SIGTERM, _request_stop)
+            handler_registered = True
+
+        try:
+            return self._train_loop(
+                state, start_step, start_epoch, pbar, trace, timer, t_last,
+                examples_per_step, window, stop_signal, side_work)
+        finally:
+            # restore only AFTER the preemption checkpoint is durably
+            # written: schedulers re-deliver SIGTERM during the grace
+            # window, and a default handler mid-save would defeat the
+            # feature; also covers the loop raising
+            if handler_registered:
+                signal.signal(signal.SIGTERM,
+                              prev_handler if prev_handler is not None
+                              else signal.SIG_DFL)
+
+    def _train_loop(self, state, start_step, start_epoch, pbar, trace, timer,
+                    t_last, examples_per_step, window, stop_signal,
+                    side_work):
+        cfg = self.config
+        global_step = start_step
+        done = False
         for epoch in range(start_epoch, self.num_epochs):
             # on resume mid-epoch, drop already-consumed batches in the
             # loader (before generation/transfer) so the data order matches
@@ -427,6 +460,15 @@ class Trainer:
                 if cfg.save_steps and global_step % cfg.save_steps == 0:
                     side_work = True
                     self.ckpt.save(global_step, state, cfg)
+
+                if stop_signal["sig"] is not None:
+                    log.warning(
+                        "termination signal received — checkpointing and "
+                        "exiting for clean resume",
+                        {"signal": stop_signal["sig"], "step": global_step},
+                    )
+                    done = True
+                    break
 
                 if global_step >= self.total_steps:
                     done = True

@@ -37,4 +37,10 @@ run gpt_long BENCH_MODE=train BENCH_MODEL=gpt-long BENCH_BATCH=1 BENCH_STEPS=10
 #    flash BACKWARD kernels too (record to compare vs 91.9 seq/s pre-bwd)
 run gpt_small BENCH_MODE=train BENCH_MODEL=gpt-small
 
+# 6. transformer MFU decomposition on TPU-compiled HLO (the CPU probe is
+#    unrepresentative here: different fusion, dense attention matrices)
+echo "=== mfu_probe bert-base ===" >&2
+timeout 900 python tools/mfu_probe.py --model bert-base --iters 10 \
+  | tee -a "$R/mfu_probe_bert_tpu_r4.jsonl"
+
 echo "done; records in $R/followup_tpu_r4.jsonl" >&2
