@@ -52,7 +52,8 @@ class TrainingConfig:
 
     # -- TPU-native additions ---------------------------------------------
     learning_rate: float = 1e-3  # reference hardcodes SGD(lr=1e-3) at ddp.py:183
-    optimizer: str = "sgd"  # sgd | momentum | adam | adamw; the reference's
+    optimizer: str = "sgd"  # sgd | momentum | adam | adamw | lamb | lars;
+    #                         the reference's
     #                         --fp16 FusedAdam path is a NameError (SURVEY.md
     #                         §2d) — here the adaptive family actually works
     momentum: float = 0.9  # for optimizer=momentum
@@ -152,7 +153,8 @@ def build_arg_parser() -> argparse.ArgumentParser:
     # TPU-native additions --------------------------------------------------
     p.add_argument("--learning_rate", type=float, default=1e-3)
     p.add_argument("--optimizer", type=str, default="sgd",
-                   choices=["sgd", "momentum", "adam", "adamw"])
+                   choices=["sgd", "momentum", "adam", "adamw", "lamb",
+                            "lars"])
     p.add_argument("--momentum", type=float, default=0.9)
     p.add_argument("--weight_decay", type=float, default=0.0)
     p.add_argument("--adam_beta1", type=float, default=0.9)

@@ -70,6 +70,10 @@ def make_optimizer(config: TrainingConfig, total_steps: int) -> tuple[optax.Grad
     schedule = linear_schedule_with_warmup(
         config.learning_rate, config.warmup_steps, total_steps
     )
+    # standard decay mask for the weight-decaying family: norms/biases/
+    # other 1-D params are excluded (decaying a LayerNorm scale toward 0
+    # fights the normalisation; every major transformer recipe masks these)
+    decay_mask = lambda params: jax.tree.map(lambda p: p.ndim > 1, params)
     kind = config.optimizer
     if kind == "sgd":
         opt = optax.sgd(learning_rate=schedule)
@@ -79,13 +83,21 @@ def make_optimizer(config: TrainingConfig, total_steps: int) -> tuple[optax.Grad
         opt = optax.adam(learning_rate=schedule, b1=config.adam_beta1,
                          b2=config.adam_beta2, eps=config.adam_eps)
     elif kind == "adamw":
-        # standard decay mask: norms/biases/other 1-D params are excluded
-        # (decaying a LayerNorm scale toward 0 fights the normalisation;
-        # every major transformer recipe masks these)
-        decay_mask = lambda params: jax.tree.map(lambda p: p.ndim > 1, params)
         opt = optax.adamw(learning_rate=schedule, b1=config.adam_beta1,
                           b2=config.adam_beta2, eps=config.adam_eps,
                           weight_decay=config.weight_decay, mask=decay_mask)
+    elif kind == "lamb":
+        # layerwise-adaptive family (this and lars): the standard recipe
+        # for the very large global batches a TPU pod makes cheap, where
+        # plain SGD/Adam need impractical LR tuning. --adam_eps applies
+        # here too (config over optax's 1e-6 default, same as adam/adamw).
+        opt = optax.lamb(learning_rate=schedule, b1=config.adam_beta1,
+                         b2=config.adam_beta2, eps=config.adam_eps,
+                         weight_decay=config.weight_decay, mask=decay_mask)
+    elif kind == "lars":
+        opt = optax.lars(learning_rate=schedule, momentum=config.momentum,
+                         weight_decay=config.weight_decay,
+                         weight_decay_mask=decay_mask)
     else:
         raise ValueError(f"unknown optimizer {kind!r}")
     tx = optax.chain(
@@ -355,24 +367,6 @@ class Trainer:
             },
         )
 
-        pbar = None
-        if is_main_process():
-            try:
-                from tqdm import tqdm
-
-                pbar = tqdm(total=self.total_steps, initial=start_step, desc="train")
-            except ImportError:
-                pbar = None
-
-        window: list[jax.Array] = []
-        side_work = False  # True when the last iteration ran eval/save/etc.
-        trace = TraceWindow(cfg.output_dir, start_step=start_step + 10,
-                            num_steps=cfg.profile_steps)
-        timer = StepTimer()
-        t_last = time.perf_counter()
-        examples_per_step = cfg.train_batch_size * cfg.gradient_accumulation_steps
-        start_epoch = start_step // self.steps_per_epoch
-
         # graceful preemption (SLURM/TPU-VM maintenance send SIGTERM):
         # finish the in-flight step, checkpoint, exit cleanly — the next
         # run auto-resumes. The reference's pre-elastic launcher just dies
@@ -387,9 +381,7 @@ class Trainer:
             handler_registered = True
 
         try:
-            return self._train_loop(
-                state, start_step, start_epoch, pbar, trace, timer, t_last,
-                examples_per_step, window, stop_signal, side_work)
+            return self._train_loop(state, start_step, stop_signal)
         finally:
             # restore only AFTER the preemption checkpoint is durably
             # written: schedulers re-deliver SIGTERM during the grace
@@ -400,10 +392,26 @@ class Trainer:
                               prev_handler if prev_handler is not None
                               else signal.SIG_DFL)
 
-    def _train_loop(self, state, start_step, start_epoch, pbar, trace, timer,
-                    t_last, examples_per_step, window, stop_signal,
-                    side_work):
+    def _train_loop(self, state, start_step, stop_signal):
         cfg = self.config
+        pbar = None
+        if is_main_process():
+            try:
+                from tqdm import tqdm
+
+                pbar = tqdm(total=self.total_steps, initial=start_step,
+                            desc="train")
+            except ImportError:
+                pbar = None
+
+        window: list[jax.Array] = []
+        side_work = False  # True when the last iteration ran eval/save/etc.
+        trace = TraceWindow(cfg.output_dir, start_step=start_step + 10,
+                            num_steps=cfg.profile_steps)
+        timer = StepTimer()
+        t_last = time.perf_counter()
+        examples_per_step = cfg.train_batch_size * cfg.gradient_accumulation_steps
+        start_epoch = start_step // self.steps_per_epoch
         global_step = start_step
         done = False
         for epoch in range(start_epoch, self.num_epochs):

@@ -252,7 +252,7 @@ class TestReviewRegressions:
 
 class TestOptimizers:
     def test_each_optimizer_steps_and_descends(self, tmp_path):
-        for kind in ["sgd", "momentum", "adam", "adamw"]:
+        for kind in ["sgd", "momentum", "adam", "adamw", "lamb", "lars"]:
             t = make_trainer(tmp_path / kind, max_steps=32, optimizer=kind,
                              learning_rate=1e-2, weight_decay=0.01)
             state, _ = t.restore_or_init()

@@ -84,6 +84,26 @@ class TestEvalOnly:
             ddp.main(_args(tmp_path / "fresh",
                            ["--eval_only", "--max_steps", "4"]))
 
+    def test_eval_only_tail_holdout_leak_rejected(self, tmp_path):
+        """A training run that used the WHOLE file store (eval_steps=0)
+        must not later have its tail rows presented as held-out."""
+        from pytorch_ddp_template_tpu.data.filestore import write_store
+
+        rng = np.random.default_rng(0)
+        store = write_store(tmp_path / "store", {
+            "image": rng.integers(0, 255, (512, 32, 32, 3)).astype("uint8"),
+            "label": rng.integers(0, 10, (512,)).astype("int32"),
+        })
+        out = tmp_path / "run"
+        args = ["--model", "resnet18", "--mesh", "data:8",
+                "--data_dir", str(store),
+                "--per_device_train_batch_size", "4", "--max_steps", "2",
+                "--save_steps", "0", "--logging_steps", "0",
+                "--output_dir", str(out)]
+        assert ddp.main(args) == 0
+        with pytest.raises(ValueError, match="held nothing out"):
+            ddp.main(args + ["--eval_only"])
+
     def test_eval_only_reports_on_saved_checkpoint(self, tmp_path):
         out = tmp_path / "run"
         assert ddp.main(_args(out, ["--max_steps", "6"])) == 0
