@@ -90,26 +90,29 @@ def main(argv: list[str] | None = None) -> int:
                 )
             if config.eval_data_dir is None and isinstance(dataset, Subset):
                 # tail holdout of a file store: only valid if the TRAINING
-                # run held the same tail out — otherwise these rows were
+                # run carved the SAME tail out — otherwise these rows were
                 # trained on and the "held-out" metrics are a leak
                 want = config.global_step or None
                 saved = trainer.ckpt.read_config(want) or {}
-                if not saved.get("eval_steps") and not saved.get("eval_data_dir"):
+                if not saved.get("eval_steps") or saved.get("eval_data_dir"):
+                    # eval_steps=0 trained on the whole store; a dedicated
+                    # eval_data_dir ALSO trained on the whole store (the
+                    # holdout came from elsewhere) — either way the tail
+                    # rows went through training
                     raise ValueError(
-                        "--eval_only: the training run held nothing out "
-                        "(eval_steps=0, no eval_data_dir), so the store's "
-                        "tail rows were trained on; pass --eval_data_dir "
-                        "with a genuinely held-out store"
+                        "--eval_only: the training run held nothing out of "
+                        "this store (its tail rows were trained on); pass "
+                        "--eval_data_dir with a genuinely held-out store"
                     )
-                if (saved.get("per_device_train_batch_size")
-                        != config.per_device_train_batch_size):
+                if saved.get("_train_batch_size") != config.train_batch_size:
                     raise ValueError(
-                        "--eval_only: per_device_train_batch_size "
-                        f"({config.per_device_train_batch_size}) differs "
-                        "from the training run's "
-                        f"({saved.get('per_device_train_batch_size')}); the "
-                        "holdout split point would move and leak training "
-                        "rows into eval — match the training batch size"
+                        "--eval_only: global train batch "
+                        f"({config.train_batch_size}) differs from the "
+                        "training run's recorded "
+                        f"({saved.get('_train_batch_size')}); the holdout "
+                        "split point would move and leak training rows "
+                        "into eval — match the training batch size and "
+                        "device count"
                     )
             state, step = trainer.restore_or_init()
             results = trainer.evaluate(state)

@@ -54,6 +54,10 @@ class CheckpointManager:
         # vs numpy fallback) — resume must replay the same stream for the
         # mid-epoch data-order restore to be exact
         payload["_native_rng"] = native.available()
+        # the GLOBAL batch (per-device x data-axis size) is not a config
+        # field but determines the eval tail-holdout split point; record
+        # it so --eval_only can verify the split is reproducible
+        payload["_train_batch_size"] = config.train_batch_size
         self._mngr.save(
             step,
             args=ocp.args.Composite(

@@ -71,7 +71,10 @@ def test_two_process_rehearsal(tmp_path):
         # replicated state agreed; the injected flip was caught
         assert r["divergence_clean"] is True
         assert r["divergence_flagged"] is True
+        # FSDP: weights really lived sharded across the two processes
+        assert r["fsdp_param_sharded"] is True
         # orbax round-trip restored bit-identical params at the right step
+        # (with FSDP on, those are genuinely distributed arrays)
         assert r["ckpt_roundtrip"] is True
         assert r["ckpt_step"] == 2
 

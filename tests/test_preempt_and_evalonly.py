@@ -104,6 +104,19 @@ class TestEvalOnly:
         with pytest.raises(ValueError, match="held nothing out"):
             ddp.main(args + ["--eval_only"])
 
+        # a run that DID hold the tail out (eval_steps>0) evaluates fine —
+        # but not at a different global batch (the split point would move)
+        out2 = tmp_path / "run2"
+        args2 = [a if a != str(out) else str(out2) for a in args]
+        args2 += ["--eval_steps", "2"]
+        assert ddp.main(args2) == 0
+        assert ddp.main(args2 + ["--eval_only"]) == 0
+        assert (out2 / "eval_2.json").is_file()
+        bad = list(args2)
+        bad[bad.index("4")] = "8"  # per-device batch 4 -> 8
+        with pytest.raises(ValueError, match="split point would move"):
+            ddp.main(bad + ["--eval_only"])
+
     def test_eval_only_reports_on_saved_checkpoint(self, tmp_path):
         out = tmp_path / "run"
         assert ddp.main(_args(out, ["--max_steps", "6"])) == 0
