@@ -104,6 +104,13 @@ def main(argv: list[str] | None = None) -> int:
                         "this store (its tail rows were trained on); pass "
                         "--eval_data_dir with a genuinely held-out store"
                     )
+                if saved.get("_train_batch_size") is None:
+                    raise ValueError(
+                        "--eval_only: this checkpoint predates batch-size "
+                        "provenance, so the holdout split point cannot be "
+                        "verified; re-save a checkpoint with the current "
+                        "version or pass --eval_data_dir"
+                    )
                 if saved.get("_train_batch_size") != config.train_batch_size:
                     raise ValueError(
                         "--eval_only: global train batch "

@@ -113,7 +113,7 @@ class TestEvalOnly:
         assert ddp.main(args2 + ["--eval_only"]) == 0
         assert (out2 / "eval_2.json").is_file()
         bad = list(args2)
-        bad[bad.index("4")] = "8"  # per-device batch 4 -> 8
+        bad[bad.index("--per_device_train_batch_size") + 1] = "8"
         with pytest.raises(ValueError, match="split point would move"):
             ddp.main(bad + ["--eval_only"])
 
