@@ -22,7 +22,11 @@ from .transformer import TransformerEncoder, default_kernel_init
 
 
 class GptDecoder(nn.Module):
-    """Decoder-only transformer LM; returns next-token logits (B, T, V)."""
+    """Decoder-only transformer LM.
+
+    Returns next-token logits ``(B, T, V)`` — or, with ``fused_head=True``,
+    final hidden states ``(B, T, E)`` for the blockwise head the task
+    applies (``ops/lm_head.py``)."""
 
     vocab_size: int = 50_257
     max_len: int = 1024
@@ -36,6 +40,11 @@ class GptDecoder(nn.Module):
     mesh: jax.sharding.Mesh | None = None
     remat: bool = False
     moe_experts: int = 0  # >0: MoE FFN (models/moe.py) in every block
+    # blockwise tied head (ops/lm_head.py): the model returns final hidden
+    # states and the task computes cross-entropy vocab-block-wise — the
+    # (B, T, V) logits tensor never exists. The memory enabler for the
+    # long-context rung (1.6 GB of logits+softmax at seq 4096, GPT-2 vocab)
+    fused_head: bool = False
 
     @nn.compact
     def __call__(self, input_ids, *, train: bool = True):
@@ -70,6 +79,8 @@ class GptDecoder(nn.Module):
             name="decoder",
         )(x, train=train)
         x = nn.LayerNorm(dtype=jnp.float32, name="final_ln")(x)
+        if self.fused_head:
+            return x.astype(self.dtype)  # head applied blockwise by the task
         logits = embed.attend(x.astype(self.dtype))  # tied head
         return logits.astype(jnp.float32)
 
@@ -78,23 +89,35 @@ class CausalLmTask(Task):
     """Next-token cross-entropy over ``batch = {"input_ids": (B, T)}``."""
 
     seq_dims = {"input_ids": 1}
+    head_block = 8192  # vocab tile width for fused_head models
 
     def model_inputs(self, batch):
         return (batch["input_ids"],)
 
     def loss(self, params, extra_vars, batch, rng, *, train=True):
         input_ids = batch["input_ids"]
-        logits, extra_vars, aux = self._apply_inputs(
+        out, extra_vars, aux = self._apply_inputs(
             params, extra_vars, (input_ids,), rng, train
         )
 
         # predict token t+1 from prefix ..t; last position has no target
-        logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
         targets = input_ids[:, 1:].astype(jnp.int32)
-        token_logp = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        if getattr(self.model, "fused_head", False):
+            # ``out`` is final hidden states; head computed blockwise
+            # against the tied table (ops/lm_head.py) — no (B,T,V) logits
+            from ..ops.lm_head import lm_head_loss
+
+            table = nn.meta.unbox(params["wte"]["embedding"])
+            token_logp, pred = lm_head_loss(
+                out[:, :-1], table, targets, block=self.head_block)
+            hits = (pred == targets).astype(jnp.float32)
+        else:
+            logp = jax.nn.log_softmax(out[:, :-1], axis=-1)
+            token_logp = jnp.take_along_axis(
+                logp, targets[..., None], axis=-1)[..., 0]
+            hits = (jnp.argmax(out[:, :-1], -1) == targets).astype(jnp.float32)
         # per-example weights (exactly-once eval) broadcast over target slots
         w = self.example_weights(batch, token_logp.shape[0])[:, None]
-        hits = (jnp.argmax(logits[:, :-1], -1) == targets).astype(jnp.float32)
         metrics = self.weighted_metrics(
             w.sum() * token_logp.shape[1], train,  # weighted target tokens
             loss=-(token_logp * w).sum(),
@@ -106,10 +129,11 @@ class CausalLmTask(Task):
 
 def gpt_small(dtype=jnp.float32, attn_impl: str = "auto", remat: bool = False,
               seq_len: int = 1024, vocab_size: int = 50_257,
-              mesh=None) -> GptDecoder:
+              mesh=None, fused_head: bool = False) -> GptDecoder:
     """GPT-2-small shape: 12 layers, 12 heads, 768 wide (~124M params)."""
     return GptDecoder(vocab_size=vocab_size, max_len=seq_len, dtype=dtype,
-                      attn_impl=attn_impl, mesh=mesh, remat=remat)
+                      attn_impl=attn_impl, mesh=mesh, remat=remat,
+                      fused_head=fused_head)
 
 
 def gpt_long(seq_len: int = 4096, dtype=jnp.float32, mesh=None,
@@ -124,6 +148,7 @@ def gpt_long(seq_len: int = 4096, dtype=jnp.float32, mesh=None,
     return GptDecoder(vocab_size=vocab_size, max_len=seq_len, dtype=dtype,
                       attn_impl=cp_impl if cp else "blockwise",
                       mesh=mesh if cp else None, remat=True,
+                      fused_head=True,  # logits never materialise (lm_head)
                       **size_overrides)
 
 

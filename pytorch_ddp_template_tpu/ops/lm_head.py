@@ -1,0 +1,162 @@
+"""Blockwise LM-head cross-entropy: the flash-attention trick applied to
+the other memory hog of causal-LM training.
+
+A dense head materialises ``(B, T, V)`` logits AND their log-softmax —
+at GPT-2 vocab (50k) and seq 4096 that is ~1.6 GB f32 per example-batch,
+dominating long-context memory (the reference has no LM at all,
+SURVEY.md §2a-10; this bounds OUR gpt-long rung). Here the vocab axis is
+processed in blocks with an online logsumexp — peak activation memory is
+``O(B*T*block)`` — and the backward recomputes each block's logits from
+the saved ``(B, T)`` logsumexp, exactly like the flash backward
+recomputes attention logits from the saved row statistics.
+
+Forward per vocab block ``[v0, v1)``:
+    logits_b = hidden @ table[v0:v1].T          (f32 on the MXU)
+    m, l     = online max / sum-exp update      (running logsumexp)
+    label    += logits_b[target] when target in the block
+    best     = running argmax (for the accuracy metric)
+    token_logp = label - (m + log l)
+
+Backward (custom_vjp, recompute per block):
+    p_b      = exp(logits_b - lse)
+    dlogits  = g * (onehot_b - p_b)
+    dhidden += dlogits @ table[v0:v1];  dtable[v0:v1] = dlogits^T @ hidden
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _num_blocks(vocab: int, block: int) -> int:
+    return -(-vocab // block)
+
+
+def _block_logits(hidden, table, step, *, block: int, vocab: int):
+    """f32 logits for vocab block ``step`` with padded rows at -inf.
+
+    ``table`` is pre-padded to ``n_blocks * block`` rows; padded logits
+    are masked so they contribute nothing to logsumexp or argmax.
+    """
+    tb = lax.dynamic_slice_in_dim(table, step * block, block, axis=0)
+    logits = lax.dot_general(
+        hidden.astype(jnp.float32), tb.astype(jnp.float32),
+        (((hidden.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (..., block)
+    v_ids = step * block + lax.iota(jnp.int32, block)
+    return jnp.where(v_ids < vocab, logits, NEG_INF), tb, v_ids
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def blockwise_lm_head(hidden, table, targets, block, vocab):
+    out, _ = _fwd(hidden, table, targets, block, vocab)
+    return out
+
+
+def _fwd(hidden, table, targets, block, vocab):
+    n = _num_blocks(vocab, block)
+    shape = targets.shape  # (...,) token positions
+
+    def body(carry, step):
+        m, l, label, best_v, best_i = carry
+        logits, _, v_ids = _block_logits(hidden, table, step,
+                                         block=block, vocab=vocab)
+        # online logsumexp
+        bm = jnp.max(logits, axis=-1)
+        m_new = jnp.maximum(m, bm)
+        l = l * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[..., None]), axis=-1)
+        # the target token's logit, when it falls in this block
+        in_blk = (targets >= step * block) & (targets < step * block + block)
+        idx = jnp.clip(targets - step * block, 0, block - 1)
+        val = jnp.take_along_axis(logits, idx[..., None], axis=-1)[..., 0]
+        label = jnp.where(in_blk, val, label)
+        # running argmax for the accuracy metric
+        bi = jnp.argmax(logits, axis=-1)
+        bv = jnp.take_along_axis(logits, bi[..., None], axis=-1)[..., 0]
+        take = bv > best_v
+        best_v = jnp.where(take, bv, best_v)
+        best_i = jnp.where(take, step * block + bi, best_i)
+        return (m_new, l, label, best_v, best_i), None
+
+    init = (
+        jnp.full(shape, NEG_INF, jnp.float32),  # m
+        jnp.zeros(shape, jnp.float32),          # l
+        jnp.zeros(shape, jnp.float32),          # label logit
+        jnp.full(shape, NEG_INF, jnp.float32),  # best value
+        jnp.zeros(shape, jnp.int32),            # best index
+    )
+    (m, l, label, _, best_i), _ = lax.scan(body, init, jnp.arange(n))
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    token_logp = label - lse
+    return (token_logp, best_i), (hidden, table, targets, lse)
+
+
+def _fwd_vjp(hidden, table, targets, block, vocab):
+    out, res = _fwd(hidden, table, targets, block, vocab)
+    return out, res
+
+
+def _bwd(block, vocab, res, cotangents):
+    g, _ = cotangents  # argmax is int: its cotangent is symbolic-zero
+    hidden, table, targets, lse = res
+    n = _num_blocks(vocab, block)
+    gf = g.astype(jnp.float32)
+
+    def body(dh, step):
+        logits, tb, _ = _block_logits(hidden, table, step,
+                                      block=block, vocab=vocab)
+        p = jnp.exp(logits - lse[..., None])                 # (..., block)
+        in_blk = (targets >= step * block) & (targets < step * block + block)
+        idx = jnp.clip(targets - step * block, 0, block - 1)
+        onehot = (jax.nn.one_hot(idx, block, dtype=jnp.float32)
+                  * in_blk[..., None].astype(jnp.float32))
+        dlogits = gf[..., None] * (onehot - p)
+        dh = dh + lax.dot_general(
+            dlogits, tb.astype(jnp.float32),
+            (((dlogits.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        batch_axes = tuple(range(dlogits.ndim - 1))
+        dtb = lax.dot_general(
+            dlogits, hidden.astype(jnp.float32),
+            (((batch_axes), (batch_axes)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block, E)
+        return dh, dtb
+
+    dh0 = jnp.zeros(hidden.shape, jnp.float32)
+    dh, dtbs = lax.scan(body, dh0, jnp.arange(n))
+    dtable = dtbs.reshape(n * block, -1)
+    return (dh.astype(hidden.dtype), dtable.astype(table.dtype), None)
+
+
+blockwise_lm_head.defvjp(_fwd_vjp, _bwd)
+
+
+def lm_head_loss(hidden, table, targets, *, block: int = 8192):
+    """``(token_logp, argmax)`` of a tied LM head, never materialising
+    the full ``(..., V)`` logits.
+
+    Args:
+      hidden: ``(..., E)`` final hidden states (any float dtype; logits
+        accumulate in f32 on the MXU).
+      table: ``(V, E)`` embedding/output table.
+      targets: ``(...)`` int target token ids.
+      block: vocab tile width; peak memory is ``O(batch * block)``.
+    """
+    vocab, _ = table.shape
+    block = min(block, vocab)
+    n = _num_blocks(vocab, block)
+    pad = n * block - vocab
+    if pad:
+        table = jnp.pad(table, ((0, pad), (0, 0)))
+    return blockwise_lm_head(hidden, table, targets.astype(jnp.int32),
+                             block, vocab)

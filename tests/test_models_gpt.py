@@ -60,8 +60,10 @@ def test_gpt_context_parallel_end_to_end(tmp_path):
 
 
 def test_ring_causal_matches_blockwise_through_model():
-    """The same weights must give the same logits whether attention runs
-    ring-distributed over the seq axis or locally blockwise."""
+    """The same weights must give the same model output (final hidden
+    states — gpt_long is fused_head) whether attention runs
+    ring-distributed over the seq axis or locally blockwise. Head parity
+    for the fused path is pinned in tests/test_lm_head.py."""
     from pytorch_ddp_template_tpu.runtime import make_mesh
     from pytorch_ddp_template_tpu.models.gpt import gpt_long
 
@@ -78,3 +80,13 @@ def test_ring_causal_matches_blockwise_through_model():
         lambda p, i: ring_model.apply({"params": p}, i, train=False)
     )(params, ids)
     np.testing.assert_allclose(local, np.asarray(ring), atol=2e-4)
+
+    # and through the fused blockwise head: the full task loss agrees too
+    from pytorch_ddp_template_tpu.models.gpt import CausalLmTask
+
+    batch = {"input_ids": ids}
+    l_local, _, _ = CausalLmTask(local_model).loss(params, {}, batch, None,
+                                                   train=False)
+    l_ring, _, _ = CausalLmTask(ring_model).loss(params, {}, batch, None,
+                                                 train=False)
+    np.testing.assert_allclose(float(l_local), float(l_ring), rtol=1e-4)
