@@ -41,6 +41,10 @@ class BertEncoder(nn.Module):
     attn_impl: str = "auto"  # Impl | "ring" (context parallelism)
     mesh: jax.sharding.Mesh | None = None
     remat: bool = False
+    # blockwise tied MLM head (ops/lm_head.py): return the transformed
+    # head hidden states; the task applies table+bias vocab-block-wise,
+    # so the (B, T, V) logits tensor never exists
+    fused_head: bool = False
 
     def setup(self):
         embed_dim = self.num_heads * self.head_dim
@@ -94,6 +98,8 @@ class BertEncoder(nn.Module):
         # MLM head: transform + tied decoder
         h = nn.gelu(self.mlm_dense(h))
         h = self.mlm_ln(h).astype(self.dtype)
+        if self.fused_head:
+            return h  # task applies the tied decoder blockwise
         logits = self.word_embed.attend(h)  # (B, T, vocab), tied weights
         return logits.astype(jnp.float32) + self.mlm_bias
 
@@ -109,6 +115,7 @@ class MlmTask(Task):
 
     MASK_TOKEN = 103  # BERT's [MASK] id
     mask_rate = 0.15
+    head_block = 8192  # vocab tile width for fused_head models
     #: sequence dim of each batch key — the loader shards it over the
     #: ``seq`` mesh axis when context parallelism is on
     seq_dims = {"input_ids": 1, "attention_mask": 1}
@@ -143,19 +150,28 @@ class MlmTask(Task):
             corrupted = jnp.where(attention_mask.astype(bool), corrupted,
                                   input_ids)
 
-        logits, extra_vars, aux = self._apply_inputs(
+        out, extra_vars, aux = self._apply_inputs(
             params, extra_vars, (corrupted, attention_mask), dropout_rng,
             train,
         )
 
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        token_logp = jnp.take_along_axis(
-            logp, input_ids[..., None].astype(jnp.int32), axis=-1
-        )[..., 0]
+        targets = input_ids.astype(jnp.int32)
+        if getattr(self.model, "fused_head", False):
+            from ..ops.lm_head import lm_head_loss
+
+            table = nn.meta.unbox(params["word_embeddings"]["embedding"])
+            bias = nn.meta.unbox(params["mlm_bias"])
+            token_logp, pred = lm_head_loss(out, table, targets, bias=bias,
+                                            block=self.head_block)
+            hits = (pred == targets).astype(jnp.float32)
+        else:
+            logp = jax.nn.log_softmax(out, axis=-1)
+            token_logp = jnp.take_along_axis(
+                logp, targets[..., None], axis=-1)[..., 0]
+            hits = (jnp.argmax(out, -1) == targets).astype(jnp.float32)
         sel = selected.astype(jnp.float32)
         # exactly-once eval: zero out whole padded examples (loader weight)
         sel = sel * self.example_weights(batch, sel.shape[0])[:, None]
-        hits = (jnp.argmax(logits, -1) == input_ids).astype(jnp.float32)
         metrics = self.weighted_metrics(
             sel.sum(), train,  # weighted selected-token count
             loss=-(token_logp * sel).sum(),
@@ -167,9 +183,10 @@ class MlmTask(Task):
 
 def bert_base(dtype=jnp.float32, attn_impl: str = "auto", remat: bool = False,
               seq_len: int = 512, vocab_size: int = 30_522,
-              mesh=None) -> BertEncoder:
+              mesh=None, fused_head: bool = False) -> BertEncoder:
     return BertEncoder(vocab_size=vocab_size, max_len=seq_len, dtype=dtype,
-                       attn_impl=attn_impl, mesh=mesh, remat=remat)
+                       attn_impl=attn_impl, mesh=mesh, remat=remat,
+                       fused_head=fused_head)
 
 
 def bert_long(seq_len: int = 4096, dtype=jnp.float32, mesh=None,
@@ -190,6 +207,7 @@ def bert_long(seq_len: int = 4096, dtype=jnp.float32, mesh=None,
     return BertEncoder(vocab_size=vocab_size, max_len=seq_len, dtype=dtype,
                        attn_impl=cp_impl if cp else "blockwise",
                        mesh=mesh if cp else None, remat=True,
+                       fused_head=True,  # logits never materialise (lm_head)
                        **size_overrides)
 
 

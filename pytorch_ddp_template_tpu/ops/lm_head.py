@@ -38,11 +38,11 @@ def _num_blocks(vocab: int, block: int) -> int:
     return -(-vocab // block)
 
 
-def _block_logits(hidden, table, step, *, block: int, vocab: int):
+def _block_logits(hidden, table, bias, step, *, block: int, vocab: int):
     """f32 logits for vocab block ``step`` with padded rows at -inf.
 
-    ``table`` is pre-padded to ``n_blocks * block`` rows; padded logits
-    are masked so they contribute nothing to logsumexp or argmax.
+    ``table``/``bias`` are pre-padded to ``n_blocks * block`` rows; padded
+    logits are masked so they contribute nothing to logsumexp or argmax.
     """
     tb = lax.dynamic_slice_in_dim(table, step * block, block, axis=0)
     logits = lax.dot_general(
@@ -50,24 +50,26 @@ def _block_logits(hidden, table, step, *, block: int, vocab: int):
         (((hidden.ndim - 1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )  # (..., block)
+    logits = logits + lax.dynamic_slice_in_dim(
+        bias, step * block, block, axis=0).astype(jnp.float32)
     v_ids = step * block + lax.iota(jnp.int32, block)
-    return jnp.where(v_ids < vocab, logits, NEG_INF), tb, v_ids
+    return jnp.where(v_ids < vocab, logits, NEG_INF), tb
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def blockwise_lm_head(hidden, table, targets, block, vocab):
-    out, _ = _fwd(hidden, table, targets, block, vocab)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def blockwise_lm_head(hidden, table, bias, targets, block, vocab):
+    out, _ = _fwd(hidden, table, bias, targets, block, vocab)
     return out
 
 
-def _fwd(hidden, table, targets, block, vocab):
+def _fwd(hidden, table, bias, targets, block, vocab):
     n = _num_blocks(vocab, block)
     shape = targets.shape  # (...,) token positions
 
     def body(carry, step):
         m, l, label, best_v, best_i = carry
-        logits, _, v_ids = _block_logits(hidden, table, step,
-                                         block=block, vocab=vocab)
+        logits, _ = _block_logits(hidden, table, bias, step,
+                                  block=block, vocab=vocab)
         # online logsumexp
         bm = jnp.max(logits, axis=-1)
         m_new = jnp.maximum(m, bm)
@@ -96,23 +98,23 @@ def _fwd(hidden, table, targets, block, vocab):
     (m, l, label, _, best_i), _ = lax.scan(body, init, jnp.arange(n))
     lse = m + jnp.log(jnp.maximum(l, 1e-30))
     token_logp = label - lse
-    return (token_logp, best_i), (hidden, table, targets, lse)
+    return (token_logp, best_i), (hidden, table, bias, targets, lse)
 
 
-def _fwd_vjp(hidden, table, targets, block, vocab):
-    out, res = _fwd(hidden, table, targets, block, vocab)
+def _fwd_vjp(hidden, table, bias, targets, block, vocab):
+    out, res = _fwd(hidden, table, bias, targets, block, vocab)
     return out, res
 
 
 def _bwd(block, vocab, res, cotangents):
     g, _ = cotangents  # argmax is int: its cotangent is symbolic-zero
-    hidden, table, targets, lse = res
+    hidden, table, bias, targets, lse = res
     n = _num_blocks(vocab, block)
     gf = g.astype(jnp.float32)
 
     def body(dh, step):
-        logits, tb, _ = _block_logits(hidden, table, step,
-                                      block=block, vocab=vocab)
+        logits, tb = _block_logits(hidden, table, bias, step,
+                                   block=block, vocab=vocab)
         p = jnp.exp(logits - lse[..., None])                 # (..., block)
         in_blk = (targets >= step * block) & (targets < step * block + block)
         idx = jnp.clip(targets - step * block, 0, block - 1)
@@ -130,18 +132,21 @@ def _bwd(block, vocab, res, cotangents):
             (((batch_axes), (batch_axes)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (block, E)
-        return dh, dtb
+        dbias_b = jnp.sum(dlogits, axis=batch_axes)          # (block,)
+        return dh, (dtb, dbias_b)
 
     dh0 = jnp.zeros(hidden.shape, jnp.float32)
-    dh, dtbs = lax.scan(body, dh0, jnp.arange(n))
+    dh, (dtbs, dbs) = lax.scan(body, dh0, jnp.arange(n))
     dtable = dtbs.reshape(n * block, -1)
-    return (dh.astype(hidden.dtype), dtable.astype(table.dtype), None)
+    dbias = dbs.reshape(n * block)
+    return (dh.astype(hidden.dtype), dtable.astype(table.dtype),
+            dbias.astype(bias.dtype), None)
 
 
 blockwise_lm_head.defvjp(_fwd_vjp, _bwd)
 
 
-def lm_head_loss(hidden, table, targets, *, block: int = 8192):
+def lm_head_loss(hidden, table, targets, *, bias=None, block: int = 8192):
     """``(token_logp, argmax)`` of a tied LM head, never materialising
     the full ``(..., V)`` logits.
 
@@ -150,13 +155,18 @@ def lm_head_loss(hidden, table, targets, *, block: int = 8192):
         accumulate in f32 on the MXU).
       table: ``(V, E)`` embedding/output table.
       targets: ``(...)`` int target token ids.
+      bias: optional ``(V,)`` output bias (BERT-style MLM head).
       block: vocab tile width; peak memory is ``O(batch * block)``.
     """
     vocab, _ = table.shape
     block = min(block, vocab)
     n = _num_blocks(vocab, block)
     pad = n * block - vocab
+    if bias is None:
+        # a zeros constant: its cotangent is dead and XLA folds the add
+        bias = jnp.zeros((vocab,), jnp.float32)
     if pad:
         table = jnp.pad(table, ((0, pad), (0, 0)))
-    return blockwise_lm_head(hidden, table, targets.astype(jnp.int32),
-                             block, vocab)
+        bias = jnp.pad(bias, (0, pad))
+    return blockwise_lm_head(hidden, table, bias,
+                             targets.astype(jnp.int32), block, vocab)

@@ -58,7 +58,7 @@ def test_bf16_hidden(case):
     np.testing.assert_allclose(lp_d, lp_b, atol=0.15)
 
 
-def test_gpt_fused_head_equals_dense_task(tmp_path):
+def test_gpt_fused_head_equals_dense_task():
     """Same params: the fused-head CausalLmTask must reproduce the dense
     head's loss, accuracy AND gradients (incl. the tied wte table)."""
     from pytorch_ddp_template_tpu.models.gpt import CausalLmTask, gpt_tiny
@@ -81,6 +81,60 @@ def test_gpt_fused_head_equals_dense_task(tmp_path):
     np.testing.assert_allclose(float(m_d["next_token_accuracy"]),
                                float(m_f["next_token_accuracy"]), rtol=1e-6)
 
+    g_d = jax.grad(lambda p: run(dense_task, p)[0])(params)
+    g_f = jax.grad(lambda p: run(fused_task, p)[0])(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=2e-5),
+        g_d, g_f)
+
+
+def test_bias_matches_dense_forward_and_grad(case):
+    """BERT-style (V,) output bias: forward and all three grads."""
+    hidden, table, targets = case
+    rng = np.random.default_rng(5)
+    bias = jnp.asarray(rng.standard_normal((V,)), jnp.float32)
+
+    def dense(h, tb, bi):
+        logits = h @ tb.T + bi
+        logp = jax.nn.log_softmax(logits, -1)
+        return jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+
+    lp_d = dense(hidden, table, bias)
+    lp_b, _ = lm_head_loss(hidden, table, targets, bias=bias, block=32)
+    np.testing.assert_allclose(lp_d, lp_b, atol=1e-5)
+
+    g_d = jax.grad(lambda h, tb, bi: -dense(h, tb, bi).mean(),
+                   argnums=(0, 1, 2))(hidden, table, bias)
+    g_b = jax.grad(
+        lambda h, tb, bi: -lm_head_loss(h, tb, targets, bias=bi,
+                                        block=32)[0].mean(),
+        argnums=(0, 1, 2))(hidden, table, bias)
+    for a, b in zip(g_d, g_b):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_bert_fused_head_equals_dense_task():
+    """Same params: fused-head MlmTask == dense MlmTask (loss, accuracy,
+    grads incl. the tied table and the vocab bias)."""
+    from pytorch_ddp_template_tpu.models.bert import MlmTask, bert_tiny
+
+    dense_task = MlmTask(bert_tiny())
+    fused_task = MlmTask(bert_tiny().clone(fused_head=True))
+    rng = np.random.default_rng(3)
+    batch = {"input_ids": jnp.asarray(rng.integers(0, 1024, (2, 128)),
+                                      jnp.int32)}
+    params, extra = dense_task.init(jax.random.PRNGKey(0), batch)
+
+    def run(task, p):
+        loss, _, m = task.loss(p, extra, batch, jax.random.PRNGKey(1),
+                               train=False)
+        return loss, m
+
+    loss_d, m_d = run(dense_task, params)
+    loss_f, m_f = run(fused_task, params)
+    np.testing.assert_allclose(float(loss_d), float(loss_f), rtol=1e-5)
+    np.testing.assert_allclose(float(m_d["mlm_accuracy"]),
+                               float(m_f["mlm_accuracy"]), rtol=1e-6)
     g_d = jax.grad(lambda p: run(dense_task, p)[0])(params)
     g_f = jax.grad(lambda p: run(fused_task, p)[0])(params)
     jax.tree.map(
