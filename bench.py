@@ -223,6 +223,7 @@ def run_bench(model: str, metric: str, unit: str, baseline: float,
     n_dev = len(devices)
     mesh = make_mesh(f"data:{n_dev}", devices)
     remat = os.environ.get("BENCH_REMAT", "") == "1"
+    fused_head = os.environ.get("BENCH_FUSED_HEAD", "") == "1"
     config = TrainingConfig(
         model=model,
         mesh=f"data:{n_dev}",
@@ -232,6 +233,7 @@ def run_bench(model: str, metric: str, unit: str, baseline: float,
         warmup_steps=0,
         max_grad_norm=1000.0,
         remat=remat,  # bandwidth-for-flops ablation (tools/mfu_probe.py twin)
+        fused_head=fused_head,  # blockwise LM head ablation (ops/lm_head.py)
     )
     seed_key = jax.random.PRNGKey(0)
     ctx = RuntimeContext(mesh=mesh, seed_key=seed_key,
@@ -296,6 +298,8 @@ def run_bench(model: str, metric: str, unit: str, baseline: float,
     }
     if remat:
         out["remat"] = True
+    if fused_head:
+        out["fused_head"] = True
     if step_flops is not None:
         kind = devices[0].device_kind
         peak = next((v for k, v in PEAK_FLOPS.items() if k in kind), None)

@@ -68,6 +68,8 @@ class TrainingConfig:
     #                     subsumes zero1)
     remat: bool = False  # rematerialise blocks (peak-memory for FLOPs trade;
     #                      long-context entries default it on regardless)
+    fused_head: bool = False  # blockwise LM head (ops/lm_head.py): no
+    #                           (B,T,V) logits; long-context LMs default on
     coordinator_address: str | None = None  # jax.distributed rendezvous
     num_processes: int | None = None
     process_id: int | None = None
@@ -173,6 +175,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "data axis (FSDP/ZeRO-3): per-chip model memory "
                         "divided by the DP degree; GSPMD inserts the "
                         "gather/scatter protocol. Subsumes --zero1.")
+    p.add_argument("--fused_head", action="store_true",
+                   help="Compute the LM head blockwise over the vocab "
+                        "(ops/lm_head.py): the (B,T,V) logits tensor never "
+                        "materialises. gpt-long/bert-long default it on; "
+                        "this turns it on for the other LM families.")
     p.add_argument("--remat", action="store_true",
                    help="Rematerialise model blocks in backward: peak "
                         "activation memory for recompute FLOPs (measured a "

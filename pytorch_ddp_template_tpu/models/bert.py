@@ -115,7 +115,6 @@ class MlmTask(Task):
 
     MASK_TOKEN = 103  # BERT's [MASK] id
     mask_rate = 0.15
-    head_block = 8192  # vocab tile width for fused_head models
     #: sequence dim of each batch key — the loader shards it over the
     #: ``seq`` mesh axis when context parallelism is on
     seq_dims = {"input_ids": 1, "attention_mask": 1}
@@ -157,13 +156,9 @@ class MlmTask(Task):
 
         targets = input_ids.astype(jnp.int32)
         if getattr(self.model, "fused_head", False):
-            from ..ops.lm_head import lm_head_loss
-
-            table = nn.meta.unbox(params["word_embeddings"]["embedding"])
-            bias = nn.meta.unbox(params["mlm_bias"])
-            token_logp, pred = lm_head_loss(out, table, targets, bias=bias,
-                                            block=self.head_block)
-            hits = (pred == targets).astype(jnp.float32)
+            token_logp, hits = self.blockwise_head(
+                out, params["word_embeddings"]["embedding"], targets,
+                bias=params["mlm_bias"])
         else:
             logp = jax.nn.log_softmax(out, axis=-1)
             token_logp = jnp.take_along_axis(

@@ -89,7 +89,6 @@ class CausalLmTask(Task):
     """Next-token cross-entropy over ``batch = {"input_ids": (B, T)}``."""
 
     seq_dims = {"input_ids": 1}
-    head_block = 8192  # vocab tile width for fused_head models
 
     def model_inputs(self, batch):
         return (batch["input_ids"],)
@@ -105,12 +104,8 @@ class CausalLmTask(Task):
         if getattr(self.model, "fused_head", False):
             # ``out`` is final hidden states; head computed blockwise
             # against the tied table (ops/lm_head.py) — no (B,T,V) logits
-            from ..ops.lm_head import lm_head_loss
-
-            table = nn.meta.unbox(params["wte"]["embedding"])
-            token_logp, pred = lm_head_loss(
-                out[:, :-1], table, targets, block=self.head_block)
-            hits = (pred == targets).astype(jnp.float32)
+            token_logp, hits = self.blockwise_head(
+                out[:, :-1], params["wte"]["embedding"], targets)
         else:
             logp = jax.nn.log_softmax(out[:, :-1], axis=-1)
             token_logp = jnp.take_along_axis(

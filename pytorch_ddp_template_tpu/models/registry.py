@@ -54,6 +54,13 @@ def build(name: str, config: TrainingConfig, mesh=None) -> tuple[Task, Dataset]:
                 "no remat knob"
             )
         task.model = task.model.clone(remat=True)
+    if config.fused_head:
+        if not hasattr(task.model, "fused_head"):
+            raise ValueError(
+                f"--fused_head: model {name!r} "
+                f"({type(task.model).__name__}) has no LM head"
+            )
+        task.model = task.model.clone(fused_head=True)
     if config.data_dir:
         from ..data.filestore import MemmapDataset
 

@@ -55,6 +55,21 @@ class Task:
         raise NotImplementedError
 
     # -- shared helpers ---------------------------------------------------
+    #: vocab tile width for fused_head LM models (ops/lm_head.py)
+    head_block = 8192
+
+    def blockwise_head(self, hidden, table, targets, bias=None):
+        """``(token_logp, hits)`` via the blockwise LM head — the shared
+        fused-head path of the LM tasks (gpt/bert). ``table``/``bias`` may
+        arrive boxed (``nn.Partitioned``) straight from init."""
+        from ..ops.lm_head import lm_head_loss
+
+        table = nn.meta.unbox(table)
+        bias = None if bias is None else nn.meta.unbox(bias)
+        token_logp, pred = lm_head_loss(hidden, table, targets, bias=bias,
+                                        block=self.head_block)
+        return token_logp, (pred == targets).astype(jnp.float32)
+
     @staticmethod
     def example_weights(batch: Batch, n: int) -> jax.Array:
         """Per-example weights for exactly-once eval.

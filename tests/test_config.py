@@ -61,3 +61,13 @@ def test_remat_flag_reaches_model():
     # models without the knob fail loudly, not silently un-rematerialised
     with pytest.raises(ValueError, match="remat"):
         build("mlp", parse_args(["--remat", "--model", "mlp"]))
+
+
+def test_fused_head_flag_reaches_model():
+    from pytorch_ddp_template_tpu.models import build
+
+    cfg = parse_args(["--fused_head", "--model", "gpt-tiny"])
+    task, _ = build(cfg.model, cfg)
+    assert task.model.fused_head is True
+    with pytest.raises(ValueError, match="fused_head"):
+        build("resnet18", parse_args(["--fused_head", "--model", "resnet18"]))

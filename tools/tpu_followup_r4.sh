@@ -37,6 +37,11 @@ run gpt_long BENCH_MODE=train BENCH_MODEL=gpt-long BENCH_BATCH=1 BENCH_STEPS=10
 #    flash BACKWARD kernels too (record to compare vs 91.9 seq/s pre-bwd)
 run gpt_small BENCH_MODE=train BENCH_MODEL=gpt-small
 
+# 5b. blockwise LM head ablation on hardware: throughput with/without the
+#     (B,T,V) logits tensor (memory win is proven; is there a time cost?)
+run gpt_small_fused BENCH_MODE=train BENCH_MODEL=gpt-small BENCH_FUSED_HEAD=1
+run bert_fused BENCH_MODE=train BENCH_MODEL=bert-base BENCH_FUSED_HEAD=1
+
 # 6. transformer MFU decomposition on TPU-compiled HLO (the CPU probe is
 #    unrepresentative here: different fusion, dense attention matrices)
 echo "=== mfu_probe bert-base ===" >&2
