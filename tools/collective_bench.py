@@ -4,7 +4,8 @@ The reference's entire native-comm capability is NCCL, exercised only
 implicitly through DDP (SURVEY.md §2c/§5.8); the community verifies such
 stacks with nccl-tests. On TPU the collectives are XLA's, emitted over
 ICI/DCN, and this harness plays the same role: for each collective
-(psum, all_gather, ppermute, reduce_scatter-equivalent) it
+(psum, all_gather, ppermute, psum_scatter — XLA's reduce_scatter —
+and all_to_all) it
 
 1. checks numerical correctness against the closed-form expectation, and
 2. measures achieved algorithm bandwidth across a size sweep.
@@ -103,6 +104,17 @@ def main(argv=None) -> int:
             None,
             1.0 / n,  # each chip sends its shard one hop
         ),
+        # psum_scatter (reduce_scatter): the ZeRO/FSDP gradient primitive —
+        # each rank ends with its reduced shard (half an allreduce's wire
+        # traffic; GSPMD emits it for fsdp_reshard'd grads)
+        "psum_scatter": (
+            lambda x: shard_map(
+                lambda v: lax.psum_scatter(v, axis, tiled=True),
+                mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False,
+            )(x),
+            None,
+            1.0 * (n - 1) / n,
+        ),
         # all_to_all: the MoE dispatch primitive (parallel/expert.py).
         # Each rank splits its shard n ways and exchanges; (n-1)/n of
         # every shard crosses the wire.
@@ -121,10 +133,10 @@ def main(argv=None) -> int:
     ok_all = True
     for name, (fn, _, bus_factor) in collectives.items():
         for elems in sizes:
-            # all_to_all re-splits each shard n ways; the rest need only n.
-            # Never round to zero — an empty array would time a no-op and
-            # count a vacuous "correct" toward the verdict.
-            quantum = n * n if name == "all_to_all" else n
+            # all_to_all and psum_scatter re-split each shard n ways; the
+            # rest need only n. Never round to zero — an empty array would
+            # time a no-op and count a vacuous "correct" toward the verdict.
+            quantum = n * n if name in ("all_to_all", "psum_scatter") else n
             elems = max((elems // quantum) * quantum, quantum)
             host = np.arange(elems, dtype=np.float32)
             x = jax.device_put(jnp.asarray(host), sharding)
@@ -138,6 +150,10 @@ def main(argv=None) -> int:
                 good = np.allclose(y, want)
             elif name == "all_gather":
                 good = np.array_equal(y, host)
+            elif name == "psum_scatter":
+                # rank r ends with the cross-rank sum of everyone's tile r
+                want = host.reshape(n, n, -1).sum(axis=0).reshape(-1)
+                good = np.allclose(y, want)
             elif name == "all_to_all":
                 # rank r ends with chunk r of every source, source-ordered:
                 # a (source, chunk) transpose of the sharded layout
