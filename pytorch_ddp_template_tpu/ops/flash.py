@@ -343,7 +343,60 @@ def _flash_fwd(q, k, v, causal, block_q, block_kv, interpret):
     return out, (q, k, v, out, lse)
 
 
+def _bwd_blockwise_xla(res, do, *, causal: bool, block_kv: int):
+    """Fallback flash backward: lax.scan over kv blocks in plain XLA.
+
+    Escape hatch (``FLASH_BWD=xla``) for the Pallas backward: its
+    in-kernel lane→sublane reshape of the per-row scalars is a Mosaic
+    relayout that has only been validated in interpret mode so far. No
+    causal block-skipping; O(block) memory like the kernels.
+    """
+    q, k, v, out, lse = res  # q,k,v,out: (B,H,S,D); lse: (B,H,S)
+    b, h, s, d = q.shape
+    t = k.shape[2]
+    block = min(block_kv, t)
+    n = t // block
+    scale = d ** -0.5
+
+    qf = q.astype(jnp.float32) * scale
+    dof = do.astype(jnp.float32)
+    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1)  # (B,H,S)
+
+    kb = jnp.moveaxis(k.astype(jnp.float32).reshape(b, h, n, block, d), 2, 0)
+    vb = jnp.moveaxis(v.astype(jnp.float32).reshape(b, h, n, block, d), 2, 0)
+
+    def body(dq_acc, inp):
+        idx, kblk, vblk = inp  # kblk/vblk: (B,H,block,D)
+        logits = jnp.einsum("bhsd,bhtd->bhst", qf, kblk)
+        if causal:
+            q_pos = lax.broadcasted_iota(jnp.int32, (s, block), 0)
+            k_pos = idx * block + lax.broadcasted_iota(jnp.int32, (s, block), 1)
+            logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+        p = jnp.exp(logits - lse[..., None])                  # (B,H,S,block)
+        dv = jnp.einsum("bhst,bhsd->bhtd", p, dof)
+        dp = jnp.einsum("bhsd,bhtd->bhst", dof, vblk)
+        ds = p * (dp - delta[..., None])                      # (B,H,S,block)
+        dq_acc = dq_acc + jnp.einsum("bhst,bhtd->bhsd", ds, kblk) * scale
+        dk = jnp.einsum("bhst,bhsd->bhtd", ds, qf)            # scale in qf
+        return dq_acc, (dk, dv)
+
+    dq0 = jnp.zeros((b, h, s, d), jnp.float32)
+    dq, (dks, dvs) = lax.scan(body, dq0, (jnp.arange(n), kb, vb))
+    dk = jnp.moveaxis(dks, 0, 2).reshape(b, h, t, d)
+    dv = jnp.moveaxis(dvs, 0, 2).reshape(b, h, t, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
 def _flash_bwd(causal, block_q, block_kv, interpret, res, do):
+    import os
+
+    # read at TRACE time: set before the process (or jax.clear_caches())
+    impl = os.environ.get("FLASH_BWD", "pallas")
+    if impl not in ("pallas", "xla"):  # a typo'd escape hatch must not
+        raise ValueError(                # silently keep the failing path
+            f"FLASH_BWD={impl!r}: expected 'pallas' or 'xla'")
+    if impl == "xla":
+        return _bwd_blockwise_xla(res, do, causal=causal, block_kv=block_kv)
     return _bwd_pallas(res, do, causal=causal, block_q=block_q,
                        block_kv=block_kv, interpret=interpret)
 

@@ -107,6 +107,27 @@ def test_flash_backward_unequal_blocks_cross_attention():
         np.testing.assert_allclose(a, b, atol=1e-5 * max(scale, 1.0))
 
 
+def test_flash_backward_xla_fallback_matches(qkv, monkeypatch):
+    """FLASH_BWD=xla routes the custom vjp to the scan fallback; grads
+    must match the Pallas backward (and therefore the reference)."""
+    q, k, v = qkv
+
+    def grads():
+        return jax.grad(
+            lambda q, k, v: jnp.sum(flash_attention(
+                q, k, v, causal=True, block_size=32) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+
+    g_pallas = grads()
+    monkeypatch.setenv("FLASH_BWD", "xla")
+    jax.clear_caches()  # the env var is read at trace time
+    g_xla = grads()
+    monkeypatch.delenv("FLASH_BWD")
+    jax.clear_caches()
+    for a, b in zip(g_pallas, g_xla):
+        np.testing.assert_allclose(a, b, atol=1e-5)
+
+
 def test_flash_backward_bf16(qkv):
     """bf16 inputs: grads come back bf16 with f32 accumulation inside."""
     q, k, v = (x.astype(jnp.bfloat16) for x in qkv)
