@@ -174,6 +174,38 @@ def test_fused_head_under_tensor_parallel_vocab_sharding(tmp_path):
     np.testing.assert_allclose(acc_d, acc_f, rtol=1e-6)
 
 
+def test_fused_head_inside_accum_scan(tmp_path):
+    """Gradient accumulation runs task.loss inside an in-jit lax.scan —
+    the fused head's own vocab scan then nests inside it. accum=2 must
+    equal the accum=1 step on the same total batch (per-step loss and
+    the next_token_accuracy metric), through the real engine."""
+    from pytorch_ddp_template_tpu.config import TrainingConfig
+    from pytorch_ddp_template_tpu.models import build
+    from pytorch_ddp_template_tpu.runtime import init
+    from pytorch_ddp_template_tpu.train import Trainer
+
+    def one_step(accum, per_dev, out):
+        cfg = TrainingConfig(
+            model="gpt-tiny", mesh="data:8", fused_head=True,
+            gradient_accumulation_steps=accum,
+            per_device_train_batch_size=per_dev, dataset_size=64,
+            max_steps=1, logging_steps=0, save_steps=0, output_dir=out,
+            seed=4,
+        )
+        ctx = init(cfg)
+        task, ds = build(cfg.model, cfg, mesh=ctx.mesh)
+        t = Trainer(cfg, ctx, task, ds)
+        state, _ = t.restore_or_init()
+        state, metrics = t.train_step(state, next(iter(t.loader.epoch(0))))
+        return (float(metrics["loss"]),
+                float(metrics["next_token_accuracy"]))
+
+    loss_a, acc_a = one_step(2, 1, str(tmp_path / "a"))
+    loss_f, acc_f = one_step(1, 2, str(tmp_path / "b"))
+    np.testing.assert_allclose(loss_a, loss_f, rtol=1e-5)
+    np.testing.assert_allclose(acc_a, acc_f, rtol=1e-6)
+
+
 def test_peak_memory_scales_with_block_not_vocab():
     """The whole point: XLA's own memory analysis must show the fused
     head's temp allocation is a small fraction of the dense head's

@@ -118,6 +118,9 @@ def test_flash_backward_xla_fallback_matches(qkv, monkeypatch):
                 q, k, v, causal=True, block_size=32) ** 2),
             argnums=(0, 1, 2))(q, k, v)
 
+    # an ambient FLASH_BWD=xla would make this a vacuous self-comparison
+    monkeypatch.delenv("FLASH_BWD", raising=False)
+    jax.clear_caches()
     g_pallas = grads()
     monkeypatch.setenv("FLASH_BWD", "xla")
     jax.clear_caches()  # the env var is read at trace time
