@@ -84,6 +84,7 @@ class TrainingConfig:
     resume: bool = True  # auto-resume from latest checkpoint in output_dir
     profile_steps: int = 0  # trace steps [10, 10+N) to output_dir/profile (SURVEY.md §5.1)
     divergence_check_steps: int = 0  # cross-host param fingerprint every N steps (§5.2)
+    preempt_sync_steps: int = 8  # multi-process SIGTERM agreement cadence (train/engine.py)
 
     @property
     def data_axis_size(self) -> int:
@@ -208,6 +209,15 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="Capture a profiler trace over N steps (from step 10).")
     p.add_argument("--divergence_check_steps", type=int, default=0,
                    help="Cross-host replicated-state fingerprint check every N steps.")
+    p.add_argument("--preempt_sync_steps", type=int, default=8,
+                   help="Multi-process runs agree on a common preemption-stop "
+                        "step by exchanging SIGTERM flags every N steps "
+                        "(single-process runs stop immediately; ignored). "
+                        "Tradeoff: each exchange is a small host-sync "
+                        "barrier, and after SIGTERM up to N-1 more steps run "
+                        "before the preemption checkpoint starts — size N so "
+                        "N steps plus one save fit the scheduler's kill "
+                        "grace window.")
     return p
 
 

@@ -30,6 +30,7 @@ from typing import Any, Callable
 import flax.struct
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from ..checkpoint.manager import CheckpointManager
@@ -392,6 +393,33 @@ class Trainer:
                               prev_handler if prev_handler is not None
                               else signal.SIG_DFL)
 
+    def _stop_agreed(self, stop_signal, global_step: int) -> bool:
+        """True when the whole fleet has agreed to stop at this step.
+
+        Single-process: stop as soon as the local flag is set.
+        Multi-process: SLURM/TPU-VM maintenance SIGTERMs every host at
+        arbitrary skew, so a host acting on its local flag alone would
+        break out at its own global_step — and the cross-process
+        checkpoint save (a collective) would hang against peers still
+        running train steps, or record mismatched steps. Instead hosts
+        exchange flags at a fixed step cadence (``--preempt_sync_steps``)
+        and all observe the same decision at the same global_step.
+        """
+        local = stop_signal["sig"] is not None
+        if jax.process_count() == 1:
+            return local
+        if global_step % max(self.config.preempt_sync_steps, 1):
+            return False
+        from jax.experimental import multihost_utils
+
+        flags = np.asarray(multihost_utils.process_allgather(
+            np.asarray([1 if local else 0], np.int32)
+        )).reshape(-1)
+        if flags.any() and not local:
+            # a peer was signalled; record it so the stop log is honest
+            stop_signal["sig"] = int(signal.SIGTERM)
+        return bool(flags.any())
+
     def _train_loop(self, state, start_step, stop_signal):
         cfg = self.config
         pbar = None
@@ -469,7 +497,7 @@ class Trainer:
                     side_work = True
                     self.ckpt.save(global_step, state, cfg)
 
-                if stop_signal["sig"] is not None:
+                if self._stop_agreed(stop_signal, global_step):
                     log.warning(
                         "termination signal received — checkpointing and "
                         "exiting for clean resume",

@@ -20,6 +20,7 @@ from pathlib import Path
 import numpy as np
 
 WORKER = Path(__file__).resolve().parent / "two_process_worker.py"
+PREEMPT_WORKER = Path(__file__).resolve().parent / "two_process_preempt_worker.py"
 REPO = WORKER.parent.parent
 
 
@@ -29,16 +30,15 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_rehearsal(tmp_path):
+def _run_pair(worker: Path, tmp_path, timeout: int = 300) -> list[str]:
     coord = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     env["PYTHONPATH"] = str(REPO)
-
     procs = [
         subprocess.Popen(
-            [sys.executable, str(WORKER), str(i), coord, str(tmp_path)],
+            [sys.executable, str(worker), str(i), coord, str(tmp_path)],
             env=env, cwd=REPO,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         )
@@ -47,7 +47,7 @@ def test_two_process_rehearsal(tmp_path):
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=300)
+            out, _ = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
@@ -55,6 +55,11 @@ def test_two_process_rehearsal(tmp_path):
         outs.append(out)
     for p, out in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+    return outs
+
+
+def test_two_process_rehearsal(tmp_path):
+    _run_pair(WORKER, tmp_path)
 
     results = {}
     for i in range(2):
@@ -89,3 +94,28 @@ def test_two_process_rehearsal(tmp_path):
     assert len(results[1]["loader_indices"]) == len(b) == 48
     assert not a & b
     assert a | b <= set(range(100))
+
+
+def test_two_process_preemption_agreement(tmp_path):
+    """SIGTERM lands on only ONE process; the --preempt_sync_steps
+    agreement (Trainer._stop_agreed) must stop both at the SAME step and
+    write one coherent cross-process checkpoint — a host acting on its
+    local flag alone would strand its peer in collective train steps
+    (ADVICE.md round-4 medium finding)."""
+    _run_pair(PREEMPT_WORKER, tmp_path)
+
+    results = {}
+    for i in range(2):
+        path = tmp_path / f"preempt_result_{i}.json"
+        assert path.is_file(), f"worker {i} wrote no result"
+        results[i] = json.loads(path.read_text())
+
+    s0, s1 = results[0]["stop_step"], results[1]["stop_step"]
+    # the whole point: both processes broke out at the same global step
+    assert s0 == s1
+    # stop happened via the agreement path (a sync-cadence step), not at
+    # the unreachable max_steps
+    assert 0 < s0 < 100_000
+    assert s0 % 4 == 0
+    # the preemption checkpoint is the agreed step on both processes
+    assert results[0]["latest_ckpt"] == results[1]["latest_ckpt"] == s0

@@ -1,0 +1,96 @@
+"""Worker for the cross-process preemption-agreement rehearsal.
+
+Launched (twice) by ``test_multiprocess.py``. Cluster schedulers deliver
+SIGTERM to *every* host, at arbitrary skew — and a host that acts on its
+local flag alone breaks out of the loop at its own global_step, leaving
+its peer stuck in collective train steps against nobody (the reference's
+pre-elastic launcher simply dies, SURVEY.md §5.3). Here only process 0 is
+signalled; the ``--preempt_sync_steps`` agreement protocol
+(``train/engine.py::Trainer._stop_agreed``) must stop BOTH processes at
+the same step and land one coherent cross-process checkpoint.
+
+Writes ``preempt_result_<proc>.json``; exit code 0 iff training exited
+cleanly through the preemption path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+from pathlib import Path
+
+
+def main() -> int:
+    proc_id, coord, workdir = int(sys.argv[1]), sys.argv[2], Path(sys.argv[3])
+    repo = Path(__file__).resolve().parent.parent
+    sys.path.insert(0, str(repo))
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from pytorch_ddp_template_tpu.config import TrainingConfig
+    from pytorch_ddp_template_tpu.models import build
+    from pytorch_ddp_template_tpu.runtime import init, shutdown
+    from pytorch_ddp_template_tpu.train.engine import Trainer
+
+    cfg = TrainingConfig(
+        cpu=True,
+        coordinator_address=coord,
+        num_processes=2,
+        process_id=proc_id,
+        mesh="data:8",
+        per_device_train_batch_size=2,
+        dataset_size=512,
+        output_dir=str(workdir / "ckpt"),
+        warmup_steps=0,
+        max_steps=100_000,  # unreachable: only SIGTERM ends this run
+        logging_steps=4,
+        save_steps=0,
+        preempt_sync_steps=4,
+        model="mlp",
+    )
+    ctx = init(cfg)
+    task, ds = build("mlp", cfg)
+    trainer = Trainer(cfg, ctx, task, ds)
+
+    if proc_id == 0:
+        # the "scheduler" preempts only this host; agreement must spread
+        # it. Fire only once the first metrics line proves the train loop
+        # (and thus the SIGTERM handler) is live — a fixed delay races
+        # handler registration and would kill the process outright. The
+        # file itself is created (empty) at Trainer construction, so wait
+        # for content, not existence.
+        metrics_path = workdir / "ckpt" / "metrics.jsonl"
+
+        def _preempt_when_training() -> None:
+            import time
+
+            deadline = time.monotonic() + 240
+            while time.monotonic() < deadline:
+                if metrics_path.exists() and metrics_path.stat().st_size > 0:
+                    os.kill(os.getpid(), signal.SIGTERM)
+                    return
+                time.sleep(0.2)
+
+        t = threading.Thread(target=_preempt_when_training, daemon=True)
+        t.start()
+
+    state = trainer.train()
+    result = {
+        "proc": proc_id,
+        "stop_step": int(state.step),
+        "latest_ckpt": trainer.ckpt.latest_step(),
+    }
+    (workdir / f"preempt_result_{proc_id}.json").write_text(json.dumps(result))
+    shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
