@@ -387,14 +387,36 @@ def _bwd_blockwise_xla(res, do, *, causal: bool, block_kv: int):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
+_bwd_impl_logged: set[str] = set()
+
+
 def _flash_bwd(causal, block_q, block_kv, interpret, res, do):
     import os
 
     # read at TRACE time: set before the process (or jax.clear_caches())
-    impl = os.environ.get("FLASH_BWD", "pallas")
+    impl = os.environ.get("FLASH_BWD")
+    if impl is None:
+        # Interpret mode (CPU CI) defaults to the Pallas kernels so they
+        # stay continuously validated; real hardware defaults to the XLA
+        # blockwise fallback until the Mosaic compile + gradient-parity
+        # record lands (ADVICE.md round-4: the in-kernel lane→sublane
+        # reshape is exactly what real Mosaic can miscompile, and a bad
+        # default would silently corrupt every long-context run).
+        impl = "pallas" if interpret else "xla"
     if impl not in ("pallas", "xla"):  # a typo'd escape hatch must not
         raise ValueError(                # silently keep the failing path
             f"FLASH_BWD={impl!r}: expected 'pallas' or 'xla'")
+    if impl not in _bwd_impl_logged:
+        # once per impl, at trace time: a stale traced value (env flipped
+        # after compilation) is visible in the logs instead of silent
+        _bwd_impl_logged.add(impl)
+        from ..utils import get_logger
+
+        get_logger(__name__).info(
+            "flash backward impl selected (trace-time; set FLASH_BWD "
+            "before first use or jax.clear_caches() to change)",
+            {"impl": impl, "interpret": interpret},
+        )
     if impl == "xla":
         return _bwd_blockwise_xla(res, do, causal=causal, block_kv=block_kv)
     return _bwd_pallas(res, do, causal=causal, block_q=block_q,
