@@ -80,6 +80,8 @@ class TrainingConfig:
     #                                   split); None = tail-holdout of data_dir
     augment: str = "none"  # on-device augmentation: none | flip | crop-flip
     eval_steps: int = 0  # 0 disables; reference evaluate() is a stub (ddp.py:123-124)
+    keep_checkpoints: int = 5  # retain the newest N step dirs (0 = unbounded);
+    #                            the reference GCs nothing (ddp.py:254-277)
     eval_only: bool = False  # evaluate a checkpoint (no training); needs one
     resume: bool = True  # auto-resume from latest checkpoint in output_dir
     profile_steps: int = 0  # trace steps [10, 10+N) to output_dir/profile (SURVEY.md §5.1)
@@ -201,6 +203,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    choices=["none", "flip", "crop-flip"],
                    help="On-device image augmentation inside the jitted step.")
     p.add_argument("--eval_steps", type=int, default=0)
+    p.add_argument("--keep_checkpoints", type=int, default=5,
+                   help="Retain only the newest N checkpoint dirs (0 = keep "
+                        "all). A long run with small --save_steps otherwise "
+                        "accumulates checkpoints without bound.")
     p.add_argument("--eval_only", action="store_true",
                    help="Run the exactly-once eval on a saved checkpoint "
                         "(latest, or --global-step) and exit — no training.")

@@ -244,7 +244,10 @@ class Trainer:
             task, self.tx, self.schedule, config.gradient_accumulation_steps
         )
         self.eval_step = make_eval_step(task)
-        self.ckpt = CheckpointManager(config.output_dir)
+        self.ckpt = CheckpointManager(
+            config.output_dir,
+            max_to_keep=config.keep_checkpoints or None,
+        )
         self.metrics_writer = MetricsWriter(config.output_dir)
 
     # -- state ------------------------------------------------------------
@@ -315,8 +318,11 @@ class Trainer:
                 raise ValueError(
                     f"checkpoint at step {want or self.ckpt.latest_step()} "
                     f"does not match the current model {self.config.model!r} "
-                    "(architecture changed since it was saved?); pass "
-                    "--no_resume or a fresh --output_dir to start over"
+                    "(architecture changed since it was saved? note: ResNet "
+                    "checkpoints from before the stageN_blockM module "
+                    "renaming use BasicBlock_N/BottleneckBlock_N keys and "
+                    "cannot be restored); pass --no_resume or a fresh "
+                    "--output_dir to start over"
                 ) from exc
             return state, int(state.step)
         return state, 0

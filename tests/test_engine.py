@@ -180,6 +180,29 @@ class TestCheckpointResume:
         assert cfg_dict["seed"] == 0
         assert cfg_dict["max_steps"] == 2
 
+    def test_keep_checkpoints_gc(self, tmp_path):
+        """--keep_checkpoints N: only the newest N step dirs survive, and
+        the latest is still restorable (the reference GCs nothing and a
+        long run with small --save_steps fills the disk)."""
+        t = make_trainer(tmp_path, max_steps=7, save_steps=1,
+                         keep_checkpoints=3)
+        t.train()
+        t.ckpt.wait()
+        assert t.ckpt.latest_step() == 7
+        assert t.ckpt.all_steps() == [5, 6, 7]
+
+        t2 = make_trainer(tmp_path, max_steps=9, save_steps=0,
+                          keep_checkpoints=3)
+        state, start = t2.restore_or_init()
+        assert start == 7
+
+    def test_keep_checkpoints_zero_keeps_all(self, tmp_path):
+        t = make_trainer(tmp_path, max_steps=5, save_steps=1,
+                         keep_checkpoints=0)
+        t.train()
+        t.ckpt.wait()
+        assert t.ckpt.all_steps() == [1, 2, 3, 4, 5]
+
 
 class TestEval:
     def test_eval_metrics_finite(self, tmp_path):
