@@ -68,15 +68,56 @@ def _emit(payload: dict) -> None:
     print(json.dumps(payload), flush=True)
 
 
+def _last_recorded(metric: str) -> dict | None:
+    """Best-known committed record for ``metric`` from bench_records/.
+
+    Surfaced in the error line during hardware outages so the round still
+    shows the best-known number — clearly labelled as a prior record,
+    never substituted into ``value`` (the driver's headline datum must
+    reflect what ran NOW, or 0).
+    """
+    import glob
+
+    records_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "bench_records")
+    best: dict | None = None
+    for path in sorted(glob.glob(os.path.join(records_dir, "*.jsonl"))):
+        try:
+            lines = open(path).read().splitlines()
+        except OSError:
+            continue
+        for line in lines:
+            try:
+                rec = json.loads(line)
+            except (ValueError, TypeError):
+                continue
+            if rec.get("metric") == metric and rec.get("value"):
+                best = {
+                    "metric": rec["metric"],
+                    "value": rec["value"],
+                    "unit": rec.get("unit"),
+                    "vs_baseline": rec.get("vs_baseline"),
+                    "source": os.path.basename(path),
+                }
+    return best
+
+
 def _fail(metric: str, unit: str, err: BaseException) -> None:
     """Hard failure → still one parseable JSON line (value 0, diagnosable)."""
-    _emit({
+    payload = {
         "metric": metric,
         "value": 0.0,
         "unit": unit,
         "vs_baseline": 0.0,
         "error": f"{type(err).__name__}: {err}",
-    })
+    }
+    try:  # best-known prior record, labelled — never merged into value
+        last = _last_recorded(metric)
+        if last is not None:
+            payload["last_recorded"] = last
+    except Exception:  # noqa: BLE001 - the error line must always emit
+        pass
+    _emit(payload)
     traceback.print_exc(file=sys.stderr)
 
 
