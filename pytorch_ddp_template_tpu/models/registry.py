@@ -69,8 +69,8 @@ def build(name: str, config: TrainingConfig, mesh=None) -> tuple[Task, Dataset]:
             # their store is in use would be the worst kind of success
             raise ValueError(
                 f"--data_dir is not supported by model {name!r} (it built a "
-                f"{type(ds).__name__}); file-backed stores currently serve "
-                "the image families"
+                f"{type(ds).__name__}); file-backed stores serve the image "
+                "and token families"
             )
     return task, ds
 
@@ -180,29 +180,23 @@ def _resnet50(config: TrainingConfig):
 @register("bert-base")
 def _bert_base(config: TrainingConfig):
     """BERT-base MLM on synthetic 512-token sequences (BASELINE.md rung 4)."""
-    from ..data.dataset import SyntheticTokenDataset
     from .bert import MlmTask, bert_base
 
     seq_len, vocab = 512, 30_522
     task = MlmTask(bert_base(dtype=_dtype(config), seq_len=seq_len,
                              vocab_size=vocab))
-    ds = SyntheticTokenDataset(samples=config.dataset_size, seq_len=seq_len,
-                               vocab=vocab, seed=config.seed)
-    return task, ds
+    return _token_entry(config, task, seq_len, vocab)
 
 
 @register("bert-tiny")
 def _bert_tiny(config: TrainingConfig):
     """2-layer BERT on short synthetic sequences — the CPU-CI language config."""
-    from ..data.dataset import SyntheticTokenDataset
     from .bert import MlmTask, bert_tiny
 
     seq_len, vocab = 128, 1024
     task = MlmTask(bert_tiny(dtype=_dtype(config), seq_len=seq_len,
                              vocab_size=vocab))
-    ds = SyntheticTokenDataset(samples=config.dataset_size, seq_len=seq_len,
-                               vocab=vocab, seed=config.seed)
-    return task, ds
+    return _token_entry(config, task, seq_len, vocab)
 
 
 @register("vit-b16")
@@ -227,7 +221,6 @@ def _vit_tiny(config: TrainingConfig):
 def _bert_long(config: TrainingConfig, mesh=None):
     """Long-context BERT (4096 tokens): ring attention over the ``seq``
     mesh axis when the mesh has one — the context-parallel rung."""
-    from ..data.dataset import SyntheticTokenDataset
     from ..runtime import make_mesh
     from .bert import MlmTask, bert_long
 
@@ -239,16 +232,13 @@ def _bert_long(config: TrainingConfig, mesh=None):
     task = MlmTask(bert_long(seq_len=seq_len, dtype=_dtype(config), mesh=mesh,
                              vocab_size=vocab, cp_impl=config.cp_impl))
     # padded batches: the ring path consumes the key-padding mask natively
-    ds = SyntheticTokenDataset(samples=config.dataset_size, seq_len=seq_len,
-                               vocab=vocab, seed=config.seed, padded=True)
-    return task, ds
+    return _token_entry(config, task, seq_len, vocab, padded=True)
 
 
 @register("bert-long-tiny")
 def _bert_long_tiny(config: TrainingConfig, mesh=None):
     """Test-sized long-context config: 2-layer BERT, 512 tokens, ring
     attention when the mesh has a ``seq`` axis (CPU-CI exercisable)."""
-    from ..data.dataset import SyntheticTokenDataset
     from ..runtime import make_mesh
     from .bert import MlmTask, bert_long
 
@@ -261,16 +251,58 @@ def _bert_long_tiny(config: TrainingConfig, mesh=None):
                              vocab_size=vocab, cp_impl=config.cp_impl,
                              num_layers=2, num_heads=4, head_dim=16,
                              mlp_dim=128))
-    ds = SyntheticTokenDataset(samples=config.dataset_size, seq_len=seq_len,
-                               vocab=vocab, seed=config.seed, padded=True)
-    return task, ds
+    return _token_entry(config, task, seq_len, vocab, padded=True)
 
 
-def _token_entry(config: TrainingConfig, task, seq_len: int, vocab: int):
+def _token_entry(config: TrainingConfig, task, seq_len: int, vocab: int,
+                 *, padded: bool = False):
+    """Token task + sequences: ``config.data_dir`` (memory-mapped token
+    store with ``input_ids`` [+ ``attention_mask``]) when set, else the
+    synthetic source — the same disk contract the image families have
+    (reference map-style dataset: ``/root/reference/dataset.py:6-17``).
+    Stores come from any tokeniser writing ``StoreWriter`` batches, or
+    ``tools/make_file_dataset.py --model gpt-small`` for a fabricated one."""
+    if config.data_dir:
+        from ..data.filestore import MemmapDataset
+
+        ds = MemmapDataset(config.data_dir)
+        if "input_ids" not in ds.arrays:
+            raise ValueError(
+                f"store {config.data_dir} lacks key 'input_ids' "
+                f"(has {sorted(ds.arrays)})"
+            )
+        ids = ds.arrays["input_ids"]
+        if not np.issubdtype(ids.dtype, np.integer):
+            raise ValueError(
+                f"store input_ids are {ids.dtype}, expected an integer type"
+            )
+        if ids.shape[1:] != (seq_len,):
+            raise ValueError(
+                f"store sequences are {list(ids.shape[1:])}, model "
+                f"{config.model} expects [{seq_len}]"
+            )
+        # bounded probe (first 1024 rows): a full memmap scan of an
+        # ImageNet-scale store would stall startup; out-of-range ids later
+        # fail loudly anyway (embedding gather is checked on CPU, and the
+        # probe catches the systematic case of a vocab mismatch)
+        probe = np.asarray(ids[: min(len(ds), 1024)])
+        if probe.size and (int(probe.min()) < 0 or int(probe.max()) >= vocab):
+            raise ValueError(
+                f"store token ids span [{int(probe.min())}, "
+                f"{int(probe.max())}], model {config.model} has vocab {vocab}"
+            )
+        if padded and "attention_mask" not in ds.arrays:
+            raise ValueError(
+                f"store {config.data_dir} lacks 'attention_mask' — the "
+                f"long-context model {config.model} consumes key-padding "
+                "masks (pad to full length with mask=1 rows if the corpus "
+                "is unpadded)"
+            )
+        return task, ds
     from ..data.dataset import SyntheticTokenDataset
 
     ds = SyntheticTokenDataset(samples=config.dataset_size, seq_len=seq_len,
-                               vocab=vocab, seed=config.seed)
+                               vocab=vocab, seed=config.seed, padded=padded)
     return task, ds
 
 
