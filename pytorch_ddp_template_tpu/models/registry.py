@@ -345,6 +345,26 @@ def _gpt_moe_tiny(config: TrainingConfig, mesh=None):
     return _token_entry(config, task, seq_len, vocab)
 
 
+@register("gpt-pipe-tiny")
+def _gpt_pipe_tiny(config: TrainingConfig, mesh=None):
+    """Pipeline-parallel causal LM: the block stack runs as a GPipe
+    fill/drain schedule over the ``pipe`` mesh axis through the ordinary
+    Trainer (models/gpt_pipe.py). Launch: ``--model gpt-pipe-tiny --mesh
+    data:4,pipe:2`` (CPU-CI exercisable)."""
+    from ..runtime import make_mesh
+    from .gpt_pipe import PipelinedGptTask
+
+    import jax
+
+    if mesh is None:
+        mesh = make_mesh(config.mesh, jax.devices())
+    seq_len, vocab = 128, 1024
+    task = PipelinedGptTask(mesh, vocab_size=vocab, seq_len=seq_len,
+                            num_layers=4, num_heads=4, head_dim=16,
+                            mlp_dim=128, dtype=_dtype(config))
+    return _token_entry(config, task, seq_len, vocab)
+
+
 @register("gpt-long")
 def _gpt_long(config: TrainingConfig, mesh=None):
     """Long-context GPT (4096 tokens): causal ring attention over the

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..runtime.context import PIPE_AXIS
+from ..runtime.context import DATA_AXIS, PIPE_AXIS
 from .stacking import check_leading_axis, stack_params
 
 
@@ -60,10 +60,22 @@ def pipeline_apply(
     Schedule: tick ``t`` runs microbatch ``t - p`` on stage ``p`` when
     ``0 <= t - p < M``; activations hop ``p → p+1`` between ticks via
     ``ppermute``. Total ``M + P - 1`` ticks — the textbook GPipe bubble.
+
+    When the mesh also has a ``data`` axis (>1), the microbatch dim is
+    sharded over it: each data replica pipelines its own batch shard
+    (pipe × data composition with real DP speedup, not replicated
+    compute). Requires ``mb % data_size == 0``.
     """
     n_stages = mesh.shape[PIPE_AXIS]
     n_micro = x.shape[0]
     check_leading_axis(stage_params, n_stages, "pipe axis")
+    data_size = mesh.shape.get(DATA_AXIS, 1)
+    if data_size > 1 and x.shape[1] % data_size:
+        raise ValueError(
+            f"pipeline microbatch size {x.shape[1]} not divisible by the "
+            f"data axis size {data_size}; adjust batch size or the "
+            "microbatch count"
+        )
 
     from jax import shard_map
 
@@ -95,15 +107,16 @@ def pipeline_apply(
         _, ys = lax.fori_loop(0, n_micro + n_stages - 1, tick, init)
         return ys[None]  # leading stage axis for the out_spec
 
-    stage_axis = P(PIPE_AXIS)
+    batch_spec = P(None, DATA_AXIS) if data_size > 1 else P()
+    out_spec = P(PIPE_AXIS, None, DATA_AXIS) if data_size > 1 else P(PIPE_AXIS)
     in_param_spec = jax.tree.map(
         lambda a: P(PIPE_AXIS, *([None] * (a.ndim - 1))), stage_params
     )
     out = shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(in_param_spec, P()),
-        out_specs=stage_axis,
+        in_specs=(in_param_spec, batch_spec),
+        out_specs=out_spec,
         check_vma=False,
     )(stage_params, x)
     # (P, M, mb, ...): every rank banked a buffer; only the last stage's

@@ -30,7 +30,9 @@ import flax.linen as nn
 import jax
 from jax.sharding import Mesh
 
-from ..runtime.context import DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, SEQ_AXIS
+from ..runtime.context import (
+    DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
+)
 
 #: logical axis -> preferred mesh axes, in priority order. A rule applies
 #: only if the mesh has that axis; otherwise the dim is replicated.
@@ -41,6 +43,7 @@ DEFAULT_RULES: tuple[tuple[str, str | None], ...] = (
     ("heads", MODEL_AXIS),   # attention head-split
     ("vocab", MODEL_AXIS),   # embedding vocab-split
     ("expert", EXPERT_AXIS),  # MoE expert-stack dim (models/moe.py)
+    ("pipe_stage", PIPE_AXIS),  # pipeline stage-stack dim (models/gpt_pipe.py)
     ("embed", None),         # row dim of fc1/qkv: replicated (activations
                              # stay unsharded along embed between blocks)
     ("kv", None),

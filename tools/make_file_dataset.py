@@ -30,7 +30,16 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--out", required=True)
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--chunk", type=int, default=1024)
+    p.add_argument("--cpu", action="store_true",
+                   help="Force the CPU backend (the axon TPU plugin hangs "
+                        "on a dead relay; dataset materialisation never "
+                        "needs the chip).")
     args = p.parse_args(argv)
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
     from pytorch_ddp_template_tpu.config import TrainingConfig
     from pytorch_ddp_template_tpu.data.filestore import materialize
