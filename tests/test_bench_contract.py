@@ -66,3 +66,22 @@ def test_unknown_mode_fails_as_json():
     assert len(lines) == 1, out[-2000:]
     assert lines[0]["value"] == 0.0
     assert "error" in lines[0]
+
+
+def test_twoproc_record_within_band():
+    """The committed two-process perf record (tools/twoproc_bench.py,
+    VERDICT r4 #7) must exist and sit in the sane band: the cross-process
+    path neither collapsed nor reported impossible speedup."""
+    import json
+    from pathlib import Path
+
+    path = Path(__file__).resolve().parent.parent / "bench_records" / \
+        "twoproc_cpu_r5.jsonl"
+    assert path.is_file(), "run tools/twoproc_bench.py to record the probe"
+    records = [json.loads(l) for l in path.read_text().splitlines() if l]
+    assert records
+    last = records[-1]
+    assert last["metric"] == "twoproc_train_steps_per_sec"
+    assert last["value"] > 0
+    assert 0.05 <= last["ratio_vs_single"] <= 3.0
+    assert last["twoproc_psum_1mib_ms"] > 0

@@ -1,0 +1,212 @@
+"""Cross-process performance probe: train throughput + collectives over a
+real two-process rendezvous (VERDICT r4 next-step #7).
+
+Single-chip environments cannot measure 1→N-chip scaling; what they CAN
+measure is the cross-process SPMD path itself — `jax.distributed`
+rendezvous, a mesh spanning two OS processes (the DCN boundary all
+multi-host code rides), sharded loading, and timed train steps +
+collectives across it. This probe records:
+
+- ``twoproc_train_steps_per_sec`` — steps/sec of the jitted train step on
+  a 2-process 8-device CPU mesh, with the single-process same-mesh number
+  and their ratio alongside;
+- ``twoproc_psum_ms`` / ``twoproc_all_gather_ms`` — cross-process
+  collective latencies at 1 MiB.
+
+Caveat recorded in every line: on a 1-core host the two processes share
+the core, so the ratio measures contention + rendezvous overhead, not
+scaling (which needs real chips; BENCH_MODE=scaling is the hardware
+harness).
+
+Usage: python tools/twoproc_bench.py [--steps 20] [--out FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def worker(role: str, coord: str, steps: int, outdir: Path) -> int:
+    """Measurement body. role: "single" (one process, 8 devices) or
+    "0"/"1" (two processes, 4 local devices each)."""
+    sys.path.insert(0, str(REPO))
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    n_local = 8 if role == "single" else 4
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={n_local}"
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax, shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pytorch_ddp_template_tpu.config import TrainingConfig
+    from pytorch_ddp_template_tpu.data.loader import ShardedLoader
+    from pytorch_ddp_template_tpu.models import build
+    from pytorch_ddp_template_tpu.runtime import init
+    from pytorch_ddp_template_tpu.train.engine import (
+        TrainState, make_optimizer, make_train_step,
+    )
+
+    cfg = TrainingConfig(
+        cpu=True, mesh="data:8", per_device_train_batch_size=32,
+        dataset_size=4096, seed=0, warmup_steps=0,
+        coordinator_address=None if role == "single" else coord,
+        num_processes=None if role == "single" else 2,
+        process_id=None if role == "single" else int(role),
+    )
+    ctx = init(cfg)
+    task, ds = build("mlp-wide", cfg)
+    loader = ShardedLoader(ds, ctx.mesh, cfg.train_batch_size, seed=0)
+    tx, schedule = make_optimizer(cfg, total_steps=10_000)
+    batches = iter(loader.epoch(0))
+    first = next(batches)
+    params, extra = task.init(ctx.seed_key, first)
+    state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                       extra_vars=extra, opt_state=tx.init(params),
+                       rng=jax.random.clone(ctx.seed_key))
+    from pytorch_ddp_template_tpu.parallel import shard_tree
+
+    state = shard_tree(state, ctx.mesh)
+    step_fn = make_train_step(task, tx, schedule)
+
+    # warmup (compile) then timed steps on a recycled batch — the input
+    # path is not what this probe measures
+    state, m = step_fn(state, first)
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = step_fn(state, first)
+    jax.block_until_ready(m["loss"])
+    steps_per_sec = steps / (time.perf_counter() - t0)
+
+    # cross-process collectives at 1 MiB f32
+    n_elem = (1 << 20) // 4
+    sharding = NamedSharding(ctx.mesh, P("data"))
+    x = jax.device_put(
+        jnp.arange(n_elem * 8, dtype=jnp.float32).reshape(8, n_elem),
+        sharding)
+
+    def timed(fn):
+        y = fn(x)
+        jax.block_until_ready(y)
+        t0 = time.perf_counter()
+        for _ in range(10):
+            y = fn(x)
+        jax.block_until_ready(y)
+        return (time.perf_counter() - t0) / 10 * 1e3  # ms
+
+    psum_fn = jax.jit(shard_map(
+        lambda a: lax.psum(a, "data"), mesh=ctx.mesh,
+        in_specs=P("data"), out_specs=P(), check_vma=False))
+    ag_fn = jax.jit(shard_map(
+        lambda a: lax.all_gather(a, "data", tiled=True), mesh=ctx.mesh,
+        in_specs=P("data"), out_specs=P(), check_vma=False))
+    psum_ms, ag_ms = timed(psum_fn), timed(ag_fn)
+
+    if role in ("single", "0"):
+        name = "single" if role == "single" else "twoproc"
+        (outdir / f"{name}.json").write_text(json.dumps({
+            "steps_per_sec": steps_per_sec,
+            "psum_1mib_ms": round(psum_ms, 3),
+            "all_gather_1mib_ms": round(ag_ms, 3),
+            "process_count": jax.process_count(),
+            "global_devices": jax.device_count(),
+            "loss": float(np.asarray(m["loss"])),
+        }))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--out", default=str(REPO / "bench_records" /
+                                         "twoproc_cpu_r5.jsonl"))
+    ap.add_argument("--worker", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--coord", default="", help=argparse.SUPPRESS)
+    ap.add_argument("--workdir", default="", help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args.worker is not None:
+        return worker(args.worker, args.coord, args.steps, Path(args.workdir))
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        workdir = Path(td)
+        env = dict(os.environ, PYTHONPATH=str(REPO))
+
+        def run(roles: list[str], coord: str) -> None:
+            procs = [
+                subprocess.Popen(
+                    [sys.executable, __file__, "--worker", r, "--coord",
+                     coord, "--workdir", str(workdir),
+                     "--steps", str(args.steps)],
+                    env=env, cwd=REPO)
+                for r in roles
+            ]
+            for p in procs:
+                if p.wait(timeout=600):
+                    raise RuntimeError(f"worker failed: rc={p.returncode}")
+
+        run(["single"], "")
+        run(["0", "1"], f"127.0.0.1:{_free_port()}")
+
+        single = json.loads((workdir / "single.json").read_text())
+        two = json.loads((workdir / "twoproc.json").read_text())
+
+    ratio = two["steps_per_sec"] / max(single["steps_per_sec"], 1e-9)
+    n_cores = os.cpu_count() or 1
+    record = {
+        "metric": "twoproc_train_steps_per_sec",
+        "value": round(two["steps_per_sec"], 3),
+        "unit": "steps/sec",
+        "single_process_steps_per_sec": round(single["steps_per_sec"], 3),
+        "ratio_vs_single": round(ratio, 3),
+        "twoproc_psum_1mib_ms": two["psum_1mib_ms"],
+        "twoproc_all_gather_1mib_ms": two["all_gather_1mib_ms"],
+        "single_psum_1mib_ms": single["psum_1mib_ms"],
+        "single_all_gather_1mib_ms": single["all_gather_1mib_ms"],
+        "host_cores": n_cores,
+        "note": ("2 processes x 4 virtual CPU devices vs 1 process x 8, "
+                 "same global batch; on a shared-core host the ratio "
+                 "measures contention + DCN-boundary overhead, not chip "
+                 "scaling"),
+    }
+    out = Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    with out.open("a") as f:
+        f.write(json.dumps(record) + "\n")
+    print(json.dumps(record))
+    # sane band: cross-process must neither collapse (a rendezvous/DCN
+    # pathology would push the ratio toward 0) nor exceed the physical
+    # envelope. Generous bounds — the host may be contended.
+    if not 0.05 <= ratio <= 3.0:
+        raise AssertionError(
+            f"two-process throughput ratio {ratio:.3f} outside sane band "
+            "[0.05, 3.0] — cross-process path pathology?"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
