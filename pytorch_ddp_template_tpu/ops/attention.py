@@ -36,9 +36,11 @@ NEG_INF = -1e30  # additive mask value; finite so 0*inf NaNs can't appear
 
 # Measured on TPU v5e (bench_records/flash_tpu_r4.jsonl): flash vs XLA is
 # 1.07x full / 1.22x causal at seq 1024, 1.13x/1.09x at 2048, and
-# 1.34x/3.24x at 4096. Below 1024 the kernel is unmeasured on hardware
-# (the judge's round-3 run saw 0.99x full at 1024 — parity at best), so
-# ``auto`` keeps the XLA path there until a record says otherwise.
+# 1.34x/3.24x at 4096 — the win grows with seq, and at 1024 the full
+# (non-causal) case is already near parity. Below 1024 there is no
+# hardware record at all (flash@512 is queued in
+# tools/tpu_followup_r4.sh), so ``auto`` keeps the XLA path there until
+# a committed record says otherwise.
 FLASH_MIN_SEQ = 1024
 
 
