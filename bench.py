@@ -265,6 +265,7 @@ def run_bench(model: str, metric: str, unit: str, baseline: float,
     mesh = make_mesh(f"data:{n_dev}", devices)
     remat = os.environ.get("BENCH_REMAT", "") == "1"
     fused_head = os.environ.get("BENCH_FUSED_HEAD", "") == "1"
+    dense_head = os.environ.get("BENCH_DENSE_HEAD", "") == "1"
     config = TrainingConfig(
         model=model,
         mesh=f"data:{n_dev}",
@@ -282,6 +283,12 @@ def run_bench(model: str, metric: str, unit: str, baseline: float,
     # pass the sub-mesh explicitly: ring-attention entries otherwise build
     # one from config.mesh over ALL devices, which breaks the scaling sweep
     task, dataset = build(model, config, mesh=mesh)
+    if dense_head:
+        # ablation baseline for the entries that DEFAULT the blockwise
+        # head on (gpt-long/bert-long): measure the dense (B,T,V) head
+        if not hasattr(task.model, "fused_head"):
+            raise ValueError(f"BENCH_DENSE_HEAD: model {model!r} has no LM head")
+        task.model = task.model.clone(fused_head=False)
 
     global_batch = per_device * n_dev
     idx = np.arange(global_batch) % len(dataset)
@@ -341,6 +348,18 @@ def run_bench(model: str, metric: str, unit: str, baseline: float,
         out["remat"] = True
     if fused_head:
         out["fused_head"] = True
+    if dense_head:
+        out["dense_head"] = True
+    if os.environ.get("FLASH_DISABLE", "") == "1":
+        out["flash_disabled"] = True
+    try:  # compiled-executable memory breakdown (peak-memory evidence for
+        # the fused-stack ablations; not all PJRT backends implement it)
+        ma = train_step.memory_analysis()
+        out["temp_mb"] = round(ma.temp_size_in_bytes / 1e6, 1)
+        out["argument_mb"] = round(ma.argument_size_in_bytes / 1e6, 1)
+        out["output_mb"] = round(ma.output_size_in_bytes / 1e6, 1)
+    except Exception:  # noqa: BLE001
+        pass
     if step_flops is not None:
         kind = devices[0].device_kind
         peak = next((v for k, v in PEAK_FLOPS.items() if k in kind), None)

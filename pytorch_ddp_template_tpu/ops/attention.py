@@ -47,6 +47,13 @@ FLASH_MIN_SEQ = 1024
 def _pick_impl(impl: Impl, q: jax.Array, k: jax.Array) -> str:
     if impl != "auto":
         return impl
+    import os
+
+    if os.environ.get("FLASH_DISABLE", "") == "1":
+        # global escape hatch (read at trace time): forces the XLA path
+        # for auto-dispatched call sites — the ablation baseline knob and
+        # the operational kill switch should a Mosaic regression land
+        return "xla"
     if jax.default_backend() == "tpu":
         # Pallas wants sublane-aligned head_dim (64 packs two rows per
         # vreg; 128 is native) and seq lengths that leave >=128 blocks

@@ -10,9 +10,12 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
   if probe; then
     sleep 10
     if probe; then
-      echo "tunnel up at $(date -u +%FT%TZ); running followup suite" >&2
+      echo "tunnel up at $(date -u +%FT%TZ); running followup suites" >&2
       bash tools/tpu_followup_r4.sh
-      exit $?
+      rc4=$?
+      bash tools/tpu_followup_r5.sh
+      rc5=$?
+      exit $(( rc4 > rc5 ? rc4 : rc5 ))
     fi
   fi
   sleep 60
