@@ -63,6 +63,9 @@ class TrainingConfig:
     adam_eps: float = 1e-8
     mesh: str = "data:-1"  # mesh spec, e.g. "data:-1" or "data:4,model:2"
     cp_impl: str = "ring"  # context-parallel engine: ring | ulysses
+    pipe_microbatches: int = 4  # GPipe microbatch count for the pipelined
+    #                             entries (models/gpt_pipe.py); clamped to
+    #                             divide the per-replica batch
     zero1: bool = False  # shard optimizer state over the data axis (ZeRO-1)
     fsdp: bool = False  # shard params+grads+opt state over data (FSDP/ZeRO-3;
     #                     subsumes zero1)
@@ -170,6 +173,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    choices=["ring", "ulysses"],
                    help="Context-parallel attention engine over the seq "
                         "axis: ring (ppermute) or ulysses (all-to-all).")
+    p.add_argument("--pipe_microbatches", type=int, default=4,
+                   help="GPipe microbatch count for the pipelined entries "
+                        "(more microbatches shrink the fill/drain bubble; "
+                        "clamped to divide the per-replica batch).")
     p.add_argument("--zero1", action="store_true",
                    help="Shard optimizer state over the data axis (ZeRO-1): "
                         "momentum/Adam memory divided by the DP degree.")

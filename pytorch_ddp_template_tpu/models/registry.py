@@ -361,7 +361,8 @@ def _gpt_pipe_tiny(config: TrainingConfig, mesh=None):
     seq_len, vocab = 128, 1024
     task = PipelinedGptTask(mesh, vocab_size=vocab, seq_len=seq_len,
                             num_layers=4, num_heads=4, head_dim=16,
-                            mlp_dim=128, dtype=_dtype(config))
+                            mlp_dim=128, dtype=_dtype(config),
+                            n_micro=config.pipe_microbatches)
     return _token_entry(config, task, seq_len, vocab)
 
 

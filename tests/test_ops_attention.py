@@ -216,3 +216,17 @@ class TestFlashDispatch:
         # must route to XLA, not pick a path that raises
         assert A._pick_impl("auto", long, cross_kv) == "xla"
         assert A._pick_impl("flash", short, short) == "flash"  # explicit
+
+
+def test_flash_disable_env_forces_xla(monkeypatch):
+    """FLASH_DISABLE=1 (trace-time) must force the XLA path out of auto
+    dispatch even on a TPU backend — the ablation/kill-switch knob."""
+    from pytorch_ddp_template_tpu.ops.attention import _pick_impl
+
+    q = jnp.zeros((1, 2048, 2, 64))
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert _pick_impl("auto", q, q) == "flash"
+    monkeypatch.setenv("FLASH_DISABLE", "1")
+    assert _pick_impl("auto", q, q) == "xla"
+    # explicit impl choices are not overridden — only auto dispatch
+    assert _pick_impl("blockwise", q, q) == "blockwise"

@@ -215,3 +215,43 @@ class TestPipelinedGptEntry:
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
                 err_msg=str(path))
+
+
+def test_pipelined_entry_checkpoint_resume(tmp_path):
+    """The stacked (pipe-sharded, Partitioned-annotated) stage params must
+    survive an orbax save/restore and continue training — the stacked
+    layout is unlike every other zoo entry's tree."""
+    from pytorch_ddp_template_tpu.config import TrainingConfig
+    from pytorch_ddp_template_tpu.models import build
+    from pytorch_ddp_template_tpu.runtime.context import RuntimeContext
+    from pytorch_ddp_template_tpu.train.engine import Trainer
+
+    def make(max_steps):
+        cfg = TrainingConfig(
+            model="gpt-pipe-tiny", mesh="data:4,pipe:2",
+            per_device_train_batch_size=2, dataset_size=128,
+            max_steps=max_steps, logging_steps=0, save_steps=2,
+            output_dir=str(tmp_path / "out"), seed=0,
+            pipe_microbatches=2,
+        )
+        mesh = make_mesh(cfg.mesh, jax.devices())
+        task, ds = build(cfg.model, cfg, mesh=mesh)
+        key = jax.random.PRNGKey(cfg.seed)
+        ctx = RuntimeContext(mesh=mesh, seed_key=key,
+                             host_key=jax.random.fold_in(key, 0), config=cfg)
+        return Trainer(cfg, ctx, task, ds)
+
+    t = make(2)
+    final = t.train()
+    assert t.ckpt.latest_step() == 2
+
+    t2 = make(4)
+    state, start = t2.restore_or_init()
+    assert start == 2
+    # restored stage stacks are bit-identical and still pipe-sharded
+    a = jax.tree.leaves(final.params["blocks"])[0]
+    b = jax.tree.leaves(state.params["blocks"])[0]
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert "pipe" in str(b.sharding.spec)
+    final2 = t2.train()
+    assert int(final2.step) == 4
