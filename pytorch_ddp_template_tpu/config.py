@@ -71,6 +71,9 @@ class TrainingConfig:
     #                     subsumes zero1)
     remat: bool = False  # rematerialise blocks (peak-memory for FLOPs trade;
     #                      long-context entries default it on regardless)
+    remat_policy: str = "block"  # block = save only block boundaries;
+    #                              save-convs = ResNet selective remat (save
+    #                              conv outputs, recompute only norm/ReLU)
     fused_head: bool = False  # blockwise LM head (ops/lm_head.py): no
     #                           (B,T,V) logits; long-context LMs default on
     coordinator_address: str | None = None  # jax.distributed rendezvous
@@ -195,6 +198,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "activation memory for recompute FLOPs (measured a "
                         "net loss on HBM-bound resnet50 — see BENCH.md — "
                         "but unlocks otherwise-OOM batch/seq configs).")
+    p.add_argument("--remat_policy", type=str, default="block",
+                   choices=["block", "save-convs"],
+                   help="With --remat: 'block' saves only block boundaries "
+                        "(re-runs the convs in backward); 'save-convs' "
+                        "(ResNet) saves conv outputs by name and recomputes "
+                        "only the norm/ReLU chains — cheap elementwise "
+                        "recompute for the post-norm activation stores.")
     p.add_argument("--coordinator_address", type=str, default=None)
     p.add_argument("--num_processes", type=int, default=None)
     p.add_argument("--process_id", type=int, default=None)

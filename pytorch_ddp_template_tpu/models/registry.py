@@ -53,7 +53,16 @@ def build(name: str, config: TrainingConfig, mesh=None) -> tuple[Task, Dataset]:
                 f"--remat: model {name!r} ({type(task.model).__name__}) has "
                 "no remat knob"
             )
-        task.model = task.model.clone(remat=True)
+        kwargs = {"remat": True}
+        if config.remat_policy == "save-convs":
+            if not hasattr(task.model, "remat_save_convs"):
+                raise ValueError(
+                    f"--remat_policy save-convs: model {name!r} "
+                    f"({type(task.model).__name__}) has no named conv "
+                    "checkpoints (ResNet-family only)"
+                )
+            kwargs["remat_save_convs"] = True
+        task.model = task.model.clone(**kwargs)
     if config.fused_head:
         if not hasattr(task.model, "fused_head"):
             raise ValueError(

@@ -22,9 +22,16 @@ from functools import partial
 from typing import Any, Callable, Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 ModuleDef = Any
+
+#: named-checkpoint tag on every block conv output — the handle the
+#: selective-remat policy (``remat_save_convs``) saves by name. Transparent
+#: (identity) when no remat policy consumes it.
+CONV_OUT = "conv_out"
 
 
 class BasicBlock(nn.Module):
@@ -39,14 +46,16 @@ class BasicBlock(nn.Module):
     @nn.compact
     def __call__(self, x):
         residual = x
-        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = checkpoint_name(self.conv(self.filters, (3, 3), self.strides)(x),
+                            CONV_OUT)
         y = self.norm()(y)
         y = self.act(y)
-        y = self.conv(self.filters, (3, 3))(y)
+        y = checkpoint_name(self.conv(self.filters, (3, 3))(y), CONV_OUT)
         y = self.norm(scale_init=nn.initializers.zeros)(y)
         if residual.shape != y.shape:
-            residual = self.conv(self.filters, (1, 1), self.strides,
-                                 name="conv_proj")(residual)
+            residual = checkpoint_name(
+                self.conv(self.filters, (1, 1), self.strides,
+                          name="conv_proj")(residual), CONV_OUT)
             residual = self.norm(name="norm_proj")(residual)
         return self.act(residual + y)
 
@@ -64,17 +73,19 @@ class BottleneckBlock(nn.Module):
     @nn.compact
     def __call__(self, x):
         residual = x
-        y = self.conv(self.filters, (1, 1))(x)
+        y = checkpoint_name(self.conv(self.filters, (1, 1))(x), CONV_OUT)
         y = self.norm()(y)
         y = self.act(y)
-        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = checkpoint_name(
+            self.conv(self.filters, (3, 3), self.strides)(y), CONV_OUT)
         y = self.norm()(y)
         y = self.act(y)
-        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = checkpoint_name(self.conv(self.filters * 4, (1, 1))(y), CONV_OUT)
         y = self.norm(scale_init=nn.initializers.zeros)(y)
         if residual.shape != y.shape:
-            residual = self.conv(self.filters * 4, (1, 1), self.strides,
-                                 name="conv_proj")(residual)
+            residual = checkpoint_name(
+                self.conv(self.filters * 4, (1, 1), self.strides,
+                          name="conv_proj")(residual), CONV_OUT)
             residual = self.norm(name="norm_proj")(residual)
         return self.act(residual + y)
 
@@ -99,6 +110,14 @@ class ResNet(nn.Module):
     # trade that can pay on an HBM-bound step where the MXU sits 75% idle
     # (tools/mfu_probe.py --remat measures whether it does here).
     remat: bool = False
+    # Selective remat (with ``remat``): save every block conv output by
+    # name and recompute only the norm/ReLU chains in backward — the
+    # roofline analysis's "cut activation traffic without re-running
+    # convs" lever (BENCH.md "Where the ResNet-50 MFU goes"): full-block
+    # remat re-runs the convs (measured a net loss on the HBM-bound
+    # step), while this spends only cheap elementwise recompute to drop
+    # the post-norm activation stores.
+    remat_save_convs: bool = False
 
     @nn.compact
     def __call__(self, x, *, train: bool = True):
@@ -141,7 +160,12 @@ class ResNet(nn.Module):
         else:
             raise ValueError(f"unknown stem {self.stem!r}")
 
-        block_cls = nn.remat(self.block_cls) if self.remat else self.block_cls
+        if self.remat:
+            policy = (jax.checkpoint_policies.save_only_these_names(CONV_OUT)
+                      if self.remat_save_convs else None)
+            block_cls = nn.remat(self.block_cls, policy=policy)
+        else:
+            block_cls = self.block_cls
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)

@@ -50,6 +50,9 @@ def main() -> None:
                     help="ResNet BatchNorm compute-dtype ablation")
     ap.add_argument("--remat", action="store_true",
                     help="rematerialise residual blocks (ResNet ablation)")
+    ap.add_argument("--save-convs", action="store_true",
+                    help="with --remat: selective policy — save conv "
+                         "outputs by name, recompute only norm/ReLU")
     ap.add_argument("--stem", default=None,
                     choices=["imagenet", "space_to_depth"],
                     help="ResNet stem ablation (space_to_depth folds 2x2 "
@@ -90,7 +93,9 @@ def main() -> None:
         nd = jnp.bfloat16 if args.norm_dtype == "bf16" else jnp.float32
         task.model = task.model.clone(norm_dtype=nd)
     if args.remat:
-        task.model = task.model.clone(remat=True)
+        task.model = task.model.clone(
+            remat=True, **({"remat_save_convs": True} if args.save_convs
+                           else {}))
     if args.stem:
         task.model = task.model.clone(stem=args.stem)
 
@@ -148,6 +153,7 @@ def main() -> None:
         row = {
             "probe": name, "model": args.model, "batch": global_batch,
             "norm_dtype": args.norm_dtype or "f32", "remat": args.remat,
+            **({"remat_policy": "save-convs"} if args.save_convs else {}),
             **({"stem": args.stem} if args.stem else {}),
             "time_ms": round(t * 1e3, 3),
             "gflops": round(c["flops"] / 1e9, 2),
