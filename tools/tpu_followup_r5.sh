@@ -35,4 +35,12 @@ run flash4096_b4 BENCH_MODE=flash BENCH_SEQ=4096
 run resnet50  BENCH_MODE=train BENCH_MODEL=resnet50
 run gpt_small BENCH_MODE=train BENCH_MODEL=gpt-small
 
-echo "done; records in $R/train_tpu_r5.jsonl" >&2
+# 4. the resnet50 MFU lever the roofline analysis names (selective remat:
+#    save conv outputs, recompute norm/ReLU) — probe all three schedules
+for flags in "" "--remat" "--remat --save-convs"; do
+  echo "=== mfu_probe resnet50 $flags ===" >&2
+  timeout 900 python tools/mfu_probe.py --model resnet50 --norm-dtype bf16 \
+    $flags | tee -a "$R/mfu_probe_tpu_r5.jsonl"
+done
+
+echo "done; records in $R/train_tpu_r5.jsonl + mfu_probe_tpu_r5.jsonl" >&2
