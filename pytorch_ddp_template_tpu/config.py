@@ -52,6 +52,7 @@ class TrainingConfig:
 
     # -- TPU-native additions ---------------------------------------------
     learning_rate: float = 1e-3  # reference hardcodes SGD(lr=1e-3) at ddp.py:183
+    lr_schedule: str = "linear"  # linear (reference parity) | cosine | constant
     optimizer: str = "sgd"  # sgd | momentum | adam | adamw | lamb | lars;
     #                         the reference's
     #                         --fp16 FusedAdam path is a NameError (SURVEY.md
@@ -163,6 +164,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="Accepted for compatibility; bf16 has a single policy.")
     # TPU-native additions --------------------------------------------------
     p.add_argument("--learning_rate", type=float, default=1e-3)
+    p.add_argument("--lr_schedule", type=str, default="linear",
+                   choices=["linear", "cosine", "constant"],
+                   help="Warmup + decay shape: linear matches the "
+                        "reference's get_linear_schedule_with_warmup; "
+                        "cosine is the standard transformer recipe; "
+                        "constant holds base LR after warmup.")
     p.add_argument("--optimizer", type=str, default="sgd",
                    choices=["sgd", "momentum", "adam", "adamw", "lamb",
                             "lars"])

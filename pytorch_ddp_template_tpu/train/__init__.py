@@ -8,7 +8,12 @@ from .engine import (
     make_train_step,
 )
 from .metrics import MetricsWriter
-from .schedule import linear_schedule_with_warmup
+from .schedule import (
+    SCHEDULES,
+    cosine_schedule_with_warmup,
+    constant_schedule_with_warmup,
+    linear_schedule_with_warmup,
+)
 
 __all__ = [
     "Trainer",
@@ -17,5 +22,8 @@ __all__ = [
     "make_eval_step",
     "make_optimizer",
     "MetricsWriter",
+    "SCHEDULES",
+    "cosine_schedule_with_warmup",
+    "constant_schedule_with_warmup",
     "linear_schedule_with_warmup",
 ]

@@ -42,7 +42,7 @@ from ..utils import get_logger, is_main_process
 from ..utils.divergence import check as divergence_check
 from ..utils.profiler import StepTimer, TraceWindow
 from .metrics import MetricsWriter
-from .schedule import linear_schedule_with_warmup
+from .schedule import SCHEDULES
 
 log = get_logger(__name__)
 
@@ -68,7 +68,7 @@ def make_optimizer(config: TrainingConfig, total_steps: int) -> tuple[optax.Grad
     Optimizer state (momentum/adam moments) mirrors the param tree, so
     ``parallel.shard_tree`` places it with the params' shardings under
     tensor parallelism."""
-    schedule = linear_schedule_with_warmup(
+    schedule = SCHEDULES[config.lr_schedule](
         config.learning_rate, config.warmup_steps, total_steps
     )
     # standard decay mask for the weight-decaying family: norms/biases/

@@ -34,3 +34,44 @@ def linear_schedule_with_warmup(
         return base_lr * jnp.where(step < float(warmup_steps), warmup_frac, decay_frac)
 
     return schedule
+
+
+def cosine_schedule_with_warmup(
+    base_lr: float, warmup_steps: int, total_steps: int
+) -> optax.Schedule:
+    """Linear warmup, then cosine decay to 0 at ``total_steps`` — the
+    standard large-batch/transformer recipe (no reference counterpart;
+    the reference is linear-only, ``ddp.py:52-61``)."""
+
+    def schedule(step: jnp.ndarray) -> jnp.ndarray:
+        step = jnp.asarray(step, jnp.float32)
+        warm = jnp.asarray(max(1.0, warmup_steps), jnp.float32)
+        warmup_frac = step / warm
+        decay_denom = jnp.maximum(1.0, float(total_steps) - float(warmup_steps))
+        progress = jnp.clip((step - float(warmup_steps)) / decay_denom, 0.0, 1.0)
+        cosine = 0.5 * (1.0 + jnp.cos(jnp.pi * progress))
+        return base_lr * jnp.where(step < float(warmup_steps), warmup_frac, cosine)
+
+    return schedule
+
+
+def constant_schedule_with_warmup(
+    base_lr: float, warmup_steps: int, total_steps: int  # noqa: ARG001 - uniform factory signature
+) -> optax.Schedule:
+    """Linear warmup, then hold ``base_lr`` (debug/short-run recipe)."""
+
+    def schedule(step: jnp.ndarray) -> jnp.ndarray:
+        step = jnp.asarray(step, jnp.float32)
+        warm = jnp.asarray(max(1.0, warmup_steps), jnp.float32)
+        warmup_frac = jnp.minimum(1.0, step / warm)
+        return base_lr * jnp.where(step < float(warmup_steps), warmup_frac,
+                                   jnp.asarray(1.0, jnp.float32))
+
+    return schedule
+
+
+SCHEDULES = {
+    "linear": linear_schedule_with_warmup,
+    "cosine": cosine_schedule_with_warmup,
+    "constant": constant_schedule_with_warmup,
+}

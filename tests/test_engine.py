@@ -332,3 +332,34 @@ class TestWeightDecayMask:
         assert float(jnp.abs(new["kernel"] - 1.0).max()) > 0.1  # decayed
         np.testing.assert_array_equal(np.asarray(new["bias"]),
                                       np.ones(4))  # masked: untouched
+
+
+class TestScheduleShapes:
+    def test_cosine_warmup_then_cosine_to_zero(self):
+        from pytorch_ddp_template_tpu.train import cosine_schedule_with_warmup
+
+        s = cosine_schedule_with_warmup(1.0, warmup_steps=10, total_steps=110)
+        assert float(s(0)) == 0.0
+        assert abs(float(s(5)) - 0.5) < 1e-6          # mid-warmup
+        assert abs(float(s(10)) - 1.0) < 1e-6         # peak at warmup end
+        assert abs(float(s(60)) - 0.5) < 1e-6         # half decay = cos(pi/2)
+        assert float(s(110)) < 1e-6                   # zero at total
+        assert float(s(200)) < 1e-6                   # floored past total
+
+    def test_constant_holds_after_warmup(self):
+        from pytorch_ddp_template_tpu.train import constant_schedule_with_warmup
+
+        s = constant_schedule_with_warmup(0.3, warmup_steps=4, total_steps=100)
+        assert abs(float(s(2)) - 0.15) < 1e-7
+        assert abs(float(s(4)) - 0.3) < 1e-7
+        assert abs(float(s(1000)) - 0.3) < 1e-7
+
+    def test_lr_schedule_flag_reaches_metrics(self, tmp_path):
+        t = make_trainer(tmp_path, max_steps=4, lr_schedule="cosine",
+                         warmup_steps=2, learning_rate=1e-2)
+        state, _ = t.restore_or_init()
+        batch = next(iter(t.loader.epoch(0)))
+        for _ in range(3):
+            state, metrics = t.train_step(state, batch)
+        # step 2 = warmup end -> peak; step 3 on the cosine arc below peak
+        assert float(metrics["lr"]) < 1e-2
