@@ -81,7 +81,10 @@ def _last_recorded(metric: str) -> dict | None:
     records_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "bench_records")
     best: dict | None = None
-    for path in sorted(glob.glob(os.path.join(records_dir, "*.jsonl"))):
+    # newest file last (mtime, not name: lexicographic order would put
+    # _r10 before _r5 and surface a stale round as "best-known")
+    for path in sorted(glob.glob(os.path.join(records_dir, "*.jsonl")),
+                       key=os.path.getmtime):
         try:
             lines = open(path).read().splitlines()
         except OSError:
@@ -604,9 +607,15 @@ def run_flash(seq: int | None = None) -> dict:
                 os.environ.pop("FLASH_BWD", None)
         tb_xla_ms = round(timed_grad(gxla) * 1e3, 3)
         results[f"{key}_bwd_autodiff_ms"] = tb_xla_ms
+        # only numerically-correct impls compete for the headline speedup:
+        # a Mosaic-miscompiled pallas bwd records its timing as a datum
+        # but must not advertise a speedup no correct config achieves
         tb_best_ms = min(
-            results.get(f"{key}_bwd_pallas_ms", float("inf")),
-            results.get(f"{key}_bwd_fallback_ms", float("inf")),
+            (results[f"{key}_bwd_{lbl}_ms"]
+             for lbl in ("pallas", "fallback")
+             if results.get(f"{key}_bwd_{lbl}_ok")
+             and f"{key}_bwd_{lbl}_ms" in results),
+            default=float("inf"),
         )
         if tb_best_ms < float("inf"):
             results[f"{key}_bwd_speedup"] = round(tb_xla_ms / tb_best_ms, 3)

@@ -164,9 +164,17 @@ def main(argv=None) -> int:
                     env=env, cwd=REPO)
                 for r in roles
             ]
-            for p in procs:
-                if p.wait(timeout=600):
-                    raise RuntimeError(f"worker failed: rc={p.returncode}")
+            try:
+                for p in procs:
+                    if p.wait(timeout=600):
+                        raise RuntimeError(
+                            f"worker failed: rc={p.returncode}")
+            finally:
+                # a crashed/timed-out worker must not orphan its sibling
+                # blocked in the jax.distributed rendezvous
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
 
         run(["single"], "")
         run(["0", "1"], f"127.0.0.1:{_free_port()}")
