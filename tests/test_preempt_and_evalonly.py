@@ -106,6 +106,30 @@ class TestKitchenSink:
         assert sum('"step": 4,' in line for line in evals) == 1, evals
         assert sum('"step": 8,' in line for line in evals) == 1, evals
 
+    def test_pipeline_flags_compose(self, tmp_path):
+        """gpt-pipe-tiny + accumulation + eval + resume on a data x pipe
+        mesh through the real CLI: the round-5 pipeline entry composes
+        with the engine's accum scan, exactly-once eval, and checkpoint
+        resume."""
+        import pathlib
+
+        out = str(tmp_path / "p")
+        args = ["--model", "gpt-pipe-tiny", "--mesh", "data:4,pipe:2",
+                "--gradient_accumulation_steps", "2",
+                "--pipe_microbatches", "2",
+                "--per_device_train_batch_size", "2", "--dataset_size", "128",
+                "--eval_steps", "2", "--logging_steps", "0",
+                "--save_steps", "2", "--output_dir", out]
+        assert ddp.main(args + ["--max_steps", "2"]) == 0
+        assert ddp.main(args + ["--max_steps", "4"]) == 0
+        ckpts = sorted(p.name for p in pathlib.Path(out).glob("checkpoint_*"))
+        assert "checkpoint_2" in ckpts and "checkpoint_4" in ckpts
+        evals = [line for line in
+                 (pathlib.Path(out) / "metrics.jsonl").read_text().splitlines()
+                 if '"eval_loss"' in line]
+        assert sum('"step": 2,' in line for line in evals) == 1, evals
+        assert sum('"step": 4,' in line for line in evals) == 1, evals
+
 
 class TestEvalOnly:
     def test_eval_only_without_checkpoint_fails_with_intent(self, tmp_path):
