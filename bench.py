@@ -68,19 +68,31 @@ def _emit(payload: dict) -> None:
     print(json.dumps(payload), flush=True)
 
 
+#: record keys that mark an ablation run — numbers taken with a lever
+#: deliberately degraded (or a kernel disabled) must never be cited as the
+#: best-known HEADLINE config during an outage
+ABLATION_KEYS = ("remat", "fused_head", "dense_head", "flash_disabled")
+
+
 def _last_recorded(metric: str) -> dict | None:
     """Best-known committed record for ``metric`` from bench_records/.
 
     Surfaced in the error line during hardware outages so the round still
     shows the best-known number — clearly labelled as a prior record,
     never substituted into ``value`` (the driver's headline datum must
-    reflect what ran NOW, or 0).
+    reflect what ran NOW, or 0). Records carrying ablation keys
+    (``ABLATION_KEYS``) are skipped; if ONLY ablation records exist for the
+    metric, the newest is surfaced with its flags listed so a degraded
+    config can never masquerade as the headline. ``BENCH_RECORDS_DIR``
+    overrides the directory (tests).
     """
     import glob
 
-    records_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "bench_records")
+    records_dir = os.environ.get("BENCH_RECORDS_DIR") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_records"
+    )
     best: dict | None = None
+    best_ablated: dict | None = None
     # newest file last (mtime, not name: lexicographic order would put
     # _r10 before _r5 and surface a stale round as "best-known")
     for path in sorted(glob.glob(os.path.join(records_dir, "*.jsonl")),
@@ -94,15 +106,22 @@ def _last_recorded(metric: str) -> dict | None:
                 rec = json.loads(line)
             except (ValueError, TypeError):
                 continue
-            if rec.get("metric") == metric and rec.get("value"):
-                best = {
-                    "metric": rec["metric"],
-                    "value": rec["value"],
-                    "unit": rec.get("unit"),
-                    "vs_baseline": rec.get("vs_baseline"),
-                    "source": os.path.basename(path),
-                }
-    return best
+            if rec.get("metric") != metric or not rec.get("value"):
+                continue
+            flags = [k for k in ABLATION_KEYS if rec.get(k)]
+            out = {
+                "metric": rec["metric"],
+                "value": rec["value"],
+                "unit": rec.get("unit"),
+                "vs_baseline": rec.get("vs_baseline"),
+                "source": os.path.basename(path),
+            }
+            if flags:
+                out["ablation_flags"] = flags
+                best_ablated = out
+            else:
+                best = out
+    return best if best is not None else best_ablated
 
 
 def _fail(metric: str, unit: str, err: BaseException) -> None:
@@ -381,7 +400,16 @@ def run_e2e(model: str, metric: str, unit: str, baseline: float) -> dict:
     dataloader every step (``/root/reference/ddp.py:216-220``); emitting
     both numbers side by side keeps the comparison honest and quantifies
     the input-path gap. ``BENCH_DATA_DIR`` runs the same config against a
-    memory-mapped file store instead of the synthetic source."""
+    memory-mapped file store instead of the synthetic source.
+
+    A third leg drives the FULL production loop (``Trainer.train()`` with
+    ``logging_steps`` on — telemetry, step accounting, stop handling) and
+    reports ``host_overhead_pct``: the gap between the pure-device number
+    and the full-loop number attributable to host work. ``BENCH_TELEMETRY``
+    (async|sync, default async) selects the scalar sink — the sync/async
+    pair IS the before/after record for the host-sync-free hot loop
+    (BENCH.md); ``BENCH_LOG_STEPS`` (default 5) sets the logging cadence,
+    ``BENCH_INFLIGHT`` the bounded dispatch depth."""
     import jax
     import numpy as np
 
@@ -443,6 +471,47 @@ def run_e2e(model: str, metric: str, unit: str, baseline: float) -> dict:
 
     dt = dt_total / timed
     per_chip = global_batch / dt / n_dev
+    # free the manual-loop replica before the full-loop leg builds its own
+    # (HBM-tight configs would otherwise hold two states live at once)
+    del state, metrics, trainer
+
+    # -- full-loop leg: the production Trainer.train() with logging on ----
+    telem = os.environ.get("BENCH_TELEMETRY", "async")
+    log_steps = int(os.environ.get("BENCH_LOG_STEPS", "5"))
+    inflight = int(os.environ.get("BENCH_INFLIGHT", "2"))
+    full_cfg = TrainingConfig(
+        model=model,
+        mesh=f"data:{n_dev}",
+        per_device_train_batch_size=per_device,
+        bf16=True,
+        dataset_size=global_batch * total_steps,
+        data_dir=os.environ.get("BENCH_DATA_DIR", ""),
+        warmup_steps=0,
+        max_grad_norm=1000.0,
+        max_steps=total_steps,
+        logging_steps=log_steps,
+        save_steps=0,
+        resume=False,
+        telemetry=telem,
+        max_inflight_steps=inflight,
+        output_dir=os.environ.get("BENCH_OUTPUT", "/tmp/bench_e2e") + "_full",
+    )
+    full_task, full_ds = build(model, full_cfg, mesh=ctx.mesh)
+    full_trainer = Trainer(full_cfg, ctx, full_task, full_ds)
+    t0 = time.perf_counter()
+    full_trainer.train()
+    full_wall = time.perf_counter() - t0
+    # steady-state loop rate from the trainer's own timer, using the MEAN:
+    # the sum of tick intervals equals elapsed loop time (compile excluded —
+    # the first tick only sets the baseline), which stays honest even for
+    # the unpaced sync leg where an async dispatch makes 4 of 5 ticks
+    # near-zero and the logging-boundary tick absorbs the device wait for
+    # all of them — a p50 there would report dispatch time, not step time
+    full_ms = full_trainer.step_timer.summary().get("step_time_mean_ms")
+    if full_ms is None:  # degenerate tiny run: fall back to wall clock
+        full_ms = 1e3 * full_wall / total_steps
+    full_per_chip = global_batch / (full_ms / 1e3) / n_dev
+
     return {
         "metric": f"{model}_e2e_ex_per_sec_per_chip",
         "value": round(per_chip, 2),
@@ -457,6 +526,17 @@ def run_e2e(model: str, metric: str, unit: str, baseline: float) -> dict:
         "cached_step_time_ms": cached["step_time_ms"],
         "input_path_overhead_pct": round(
             100 * (cached["value"] - per_chip) / cached["value"], 2
+        ) if cached["value"] else None,
+        # full production loop vs pure device compute: the host-work gap.
+        # sync-vs-async BENCH_TELEMETRY pairs of this field are the
+        # before/after evidence for the host-sync-free hot loop
+        "telemetry": telem,
+        "logging_steps": log_steps,
+        "max_inflight_steps": inflight,
+        "full_loop_per_chip": round(full_per_chip, 2),
+        "full_loop_step_time_ms": round(full_ms, 2),
+        "host_overhead_pct": round(
+            100 * (cached["value"] - full_per_chip) / cached["value"], 2
         ) if cached["value"] else None,
     }
 

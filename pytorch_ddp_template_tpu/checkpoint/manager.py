@@ -36,12 +36,26 @@ class CheckpointManager:
 
     def __init__(self, directory: str | Path, *, max_to_keep: int | None = None):
         self.directory = Path(directory).absolute()
-        options = ocp.CheckpointManagerOptions(
+        base = dict(
             max_to_keep=max_to_keep,
             step_prefix="checkpoint",  # dirs named checkpoint_<step>, like the
             #                            reference's checkpoint-<step> (ddp.py:256)
             create=True,
         )
+        try:
+            # pin the async path explicitly (it is orbax's default, but the
+            # engine's side-work accounting relies on save() being a
+            # schedule-and-return, so state the contract rather than
+            # inherit it)
+            options = ocp.CheckpointManagerOptions(
+                enable_async_checkpointing=True, **base
+            )
+        except TypeError:  # older orbax without the kwarg: default is async
+            options = ocp.CheckpointManagerOptions(**base)
+        #: save() schedules the write and returns; wait() is the durability
+        #: barrier. The engine uses this to decide whether a save tripped
+        #: the step-timer discard.
+        self.is_async = True
         self._mngr = ocp.CheckpointManager(self.directory, options=options)
 
     # -- save -------------------------------------------------------------

@@ -93,7 +93,15 @@ class TrainingConfig:
     resume: bool = True  # auto-resume from latest checkpoint in output_dir
     profile_steps: int = 0  # trace steps [10, 10+N) to output_dir/profile (SURVEY.md §5.1)
     divergence_check_steps: int = 0  # cross-host param fingerprint every N steps (§5.2)
-    preempt_sync_steps: int = 8  # multi-process SIGTERM agreement cadence (train/engine.py)
+    preempt_sync_steps: int = 8  # legacy (accepted, unused): SIGTERM agreement
+    #                              now rides inside the jitted step every step
+    telemetry: str = "async"  # async (device arrays drained off-thread) | sync
+    #                           (inline host conversion — the pre-async loop,
+    #                           kept as the host_overhead_pct "before" leg)
+    max_inflight_steps: int = 2  # bounded dispatch depth: the loop reads one
+    #                              scalar from the step N-K dispatch each
+    #                              iteration, capping host-side buffer growth
+    #                              and carrying the device-side stop agreement
 
     @property
     def data_axis_size(self) -> int:
@@ -240,14 +248,27 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--divergence_check_steps", type=int, default=0,
                    help="Cross-host replicated-state fingerprint check every N steps.")
     p.add_argument("--preempt_sync_steps", type=int, default=8,
-                   help="Multi-process runs agree on a common preemption-stop "
-                        "step by exchanging SIGTERM flags every N steps "
-                        "(single-process runs stop immediately; ignored). "
-                        "Tradeoff: each exchange is a small host-sync "
-                        "barrier, and after SIGTERM up to N-1 more steps run "
-                        "before the preemption checkpoint starts — size N so "
-                        "N steps plus one save fit the scheduler's kill "
-                        "grace window.")
+                   help="Accepted for compatibility; unused. Multi-process "
+                        "SIGTERM agreement now travels inside the jitted "
+                        "train step (a device-side reduction over per-"
+                        "process stop votes) and is read through the "
+                        "bounded dispatch-depth barrier, so no host "
+                        "allgather cadence exists anymore.")
+    p.add_argument("--telemetry", type=str, default="async",
+                   choices=["async", "sync"],
+                   help="Scalar sink for logging_steps: 'async' hands device "
+                        "arrays to a background drain thread (the loop "
+                        "never blocks on a logging boundary; scalars may "
+                        "land up to one interval late, step keys exact); "
+                        "'sync' converts inline (pre-async behaviour, the "
+                        "host_overhead_pct before-leg in BENCH_MODE=e2e).")
+    p.add_argument("--max_inflight_steps", type=int, default=2,
+                   help="Bounded dispatch depth K: each iteration the loop "
+                        "reads one scalar produced K steps ago (complete in "
+                        "steady state, so the read is ~free). Caps host-side "
+                        "buffer growth and, on multi-process runs, carries "
+                        "the device-side preemption-stop agreement (stop "
+                        "lands within K steps of every host voting).")
     return p
 
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Iterator, Mapping
 
 import jax
@@ -79,6 +80,16 @@ class ShardedLoader:
                 f"size {data_size}"
             )
         self._local_batch = self.global_batch_size // self._procs
+        # host input-path accounting, cumulative across epochs: gather_s is
+        # producer-side work (index gather + H2D assembly, overlapped with
+        # compute when prefetching); consumer_wait_s is time the *training
+        # loop* actually stalled waiting on this loader — the number that
+        # belongs in host-overhead attribution (engine logs it per interval
+        # as input_wait_ms). Plain float adds under the GIL: safe enough
+        # for telemetry across the producer/consumer threads.
+        self.stats: dict[str, float] = {
+            "gather_s": 0.0, "consumer_wait_s": 0.0, "batches": 0.0,
+        }
         self.accum_steps = int(accum_steps)
         if self.accum_steps < 1:
             raise ValueError("accum_steps must be >= 1")
@@ -211,14 +222,21 @@ class ShardedLoader:
         batches = self._host_batches(epoch)[start_batch:]
 
         def _gather(idx: np.ndarray, w: np.ndarray | None) -> dict:
+            t0 = time.perf_counter()
             local = dict(self.dataset.batch(idx))
             if w is not None:
                 local["__weight__"] = w
-            return self._assemble(local)
+            out = self._assemble(local)
+            self.stats["gather_s"] += time.perf_counter() - t0
+            self.stats["batches"] += 1
+            return out
 
         if self.prefetch <= 0:
             for idx, w in batches:
-                yield _gather(idx, w)
+                batch = _gather(idx, w)
+                # no prefetch thread: the gather itself is the consumer stall
+                self.stats["consumer_wait_s"] = self.stats["gather_s"]
+                yield batch
             return
 
         q: queue.Queue = queue.Queue(maxsize=self.prefetch)
@@ -251,7 +269,9 @@ class ShardedLoader:
         thread.start()
         try:
             while True:
+                t0 = time.perf_counter()
                 item = q.get()
+                self.stats["consumer_wait_s"] += time.perf_counter() - t0
                 if item is _SENTINEL:
                     break
                 if isinstance(item, Exception):

@@ -37,8 +37,11 @@ from jax import lax
 
 from ..parallel.pipeline import pipeline_apply
 from ..runtime.context import PIPE_AXIS
+from ..utils import get_logger
 from .gpt import CausalLmTask
 from .transformer import EncoderBlock, default_kernel_init
+
+log = get_logger(__name__)
 
 #: logical axis name for the stacked stage dim (parallel/sharding.py maps
 #: it onto the ``pipe`` mesh axis)
@@ -83,6 +86,7 @@ class PipelinedGptTask(CausalLmTask):
         self.embed_dim = num_heads * head_dim
         self.dtype = dtype
         self.n_micro = n_micro
+        self._clamp_warned = False
         # dropout 0: the pipelined forward is RNG-free, so stage_fn needs
         # no per-stage rng plumbing through the ppermute schedule
         self._block = EncoderBlock(
@@ -151,6 +155,19 @@ class PipelinedGptTask(CausalLmTask):
 
         per_replica = b // self.mesh.shape.get(DATA_AXIS, 1)
         m = math.gcd(self.n_micro, per_replica)
+        if m < self.n_micro and not self._clamp_warned:
+            # a coprime batch/microbatch combination silently serialises
+            # the pipeline (m=1 == no overlap at all) — say so once, at
+            # trace time, instead of letting the fill/drain bubble eat the
+            # speedup invisibly
+            self._clamp_warned = True
+            log.warning(
+                "--pipe_microbatches clamped: gcd(n_micro, per-replica "
+                "batch) < requested — the GPipe fill/drain bubble grows; "
+                "pick a per-replica batch divisible by the microbatch count",
+                {"requested": self.n_micro, "effective": m,
+                 "per_replica_batch": per_replica},
+            )
         xm = x.reshape(m, b // m, t, self.embed_dim)
 
         block = self._block

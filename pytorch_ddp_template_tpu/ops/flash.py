@@ -39,6 +39,9 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+#: renamed TPUCompilerParams → CompilerParams across jax versions; same kwargs
+CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
 NEG_INF = -1e30
 LANES = 128
 
@@ -126,7 +129,7 @@ def _fwd_pallas(q, k, v, *, causal: bool, block_q: int, block_kv: int,
             pltpu.VMEM((block_q, LANES), jnp.float32),  # m
             pltpu.VMEM((block_q, LANES), jnp.float32),  # l
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -283,7 +286,7 @@ def _bwd_pallas(res, do, *, causal: bool, block_q: int, block_kv: int,
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -322,7 +325,7 @@ def _bwd_pallas(res, do, *, causal: bool, block_q: int, block_kv: int,
             pltpu.VMEM((block_kv, d), jnp.float32),
             pltpu.VMEM((block_kv, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

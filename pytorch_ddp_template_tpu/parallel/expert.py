@@ -77,7 +77,7 @@ def expert_apply(
     local = tokens // groups
     cap = local if capacity is None else capacity
 
-    from jax import shard_map
+    from .shard_map_compat import shard_map
 
     def per_device(params, x_local):
         params = jax.tree.map(lambda a: a[0], params)

@@ -77,7 +77,7 @@ def pipeline_apply(
             "microbatch count"
         )
 
-    from jax import shard_map
+    from .shard_map_compat import shard_map
 
     def per_device(params, x_local):
         # shard_map hands each rank its stage slice with the (length-1)

@@ -28,8 +28,21 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+
 from jax.sharding import Mesh, PartitionSpec as P
+
+from .shard_map_compat import shard_map
+
+
+def _axis_size(axis_name) -> int:
+    """Static size of the named mesh axis (``lax.axis_size`` where it
+    exists; pre-0.5 jax exposes it as the ``core.axis_frame`` value)."""
+    if hasattr(lax, "axis_size"):
+        return int(lax.axis_size(axis_name))
+    from jax._src import core as _core
+
+    frame = _core.axis_frame(axis_name)
+    return int(frame if isinstance(frame, int) else frame.size)
 
 from ..ops.attention import (
     online_softmax_finish,
@@ -58,7 +71,7 @@ def ring_attention_local(
         each shard masks remote chunks correctly.
     Returns the local output chunk ``(B, S_local, H, D)``.
     """
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
     scale = d ** -0.5

@@ -1,0 +1,31 @@
+"""``shard_map`` across jax versions.
+
+Two spellings moved under us: the function lives at ``jax.shard_map`` on
+current jax but ``jax.experimental.shard_map.shard_map`` before 0.5, and
+the replication-check kwarg renamed ``check_rep`` → ``check_vma``. Callers
+here use the modern spelling; this wrapper maps it onto whatever the
+installed jax accepts.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pre-0.5 jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool | None = None):
+    kwargs = {}
+    if check_vma is not None:
+        if "check_vma" in _PARAMS:
+            kwargs["check_vma"] = check_vma
+        elif "check_rep" in _PARAMS:
+            kwargs["check_rep"] = check_vma
+        # neither spelling: the check cannot be disabled; proceed without
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kwargs)

@@ -26,8 +26,9 @@ from __future__ import annotations
 
 import jax
 from jax import lax
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from .shard_map_compat import shard_map
 
 from ..runtime.context import SEQ_AXIS
 

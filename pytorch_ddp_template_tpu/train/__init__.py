@@ -5,9 +5,15 @@ from .engine import (
     TrainState,
     make_eval_step,
     make_optimizer,
+    make_stop_flags,
     make_train_step,
 )
-from .metrics import MetricsWriter
+from .metrics import (
+    AsyncTelemetry,
+    MetricsWriter,
+    SyncTelemetry,
+    make_telemetry,
+)
 from .schedule import (
     SCHEDULES,
     cosine_schedule_with_warmup,
@@ -21,7 +27,11 @@ __all__ = [
     "make_train_step",
     "make_eval_step",
     "make_optimizer",
+    "make_stop_flags",
     "MetricsWriter",
+    "AsyncTelemetry",
+    "SyncTelemetry",
+    "make_telemetry",
     "SCHEDULES",
     "cosine_schedule_with_warmup",
     "constant_schedule_with_warmup",

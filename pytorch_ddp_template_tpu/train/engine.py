@@ -18,6 +18,15 @@ ddp.py:126-288``), redesigned for XLA rather than translated:
   sharded over the ``data`` mesh axis and params are replicated, so GSPMD
   inserts the reduce — ``lax.psum`` semantics without naming it (the whole
   NCCL-DDP replacement, SURVEY.md §5.8).
+
+Steady-state host discipline (the async-dispatch contract): the loop never
+converts a device value to host inline. Scalars for ``logging_steps`` go to
+a telemetry sink as device arrays (drained off-thread); the multi-process
+preemption-stop agreement is a device-side reduction over per-process stop
+votes *inside* the jitted step (no ``process_allgather`` cadence); the only
+blocking point is the bounded dispatch-depth barrier — one host read per
+iteration of a scalar produced ``--max_inflight_steps`` dispatches ago,
+which in steady state has already retired and costs ~nothing.
 """
 
 from __future__ import annotations
@@ -25,6 +34,7 @@ from __future__ import annotations
 import signal
 import threading
 import time
+from collections import deque
 from typing import Any, Callable
 
 import flax.struct
@@ -32,6 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..checkpoint.manager import CheckpointManager
 from ..config import TrainingConfig
@@ -39,9 +50,9 @@ from ..data.loader import ShardedLoader
 from ..models.task import Task
 from ..runtime.context import RuntimeContext
 from ..utils import get_logger, is_main_process
-from ..utils.divergence import check as divergence_check
+from ..utils.divergence import DivergenceMonitor
 from ..utils.profiler import StepTimer, TraceWindow
-from .metrics import MetricsWriter
+from .metrics import MetricsWriter, SyncTelemetry, make_telemetry
 from .schedule import SCHEDULES
 
 log = get_logger(__name__)
@@ -108,13 +119,37 @@ def make_optimizer(config: TrainingConfig, total_steps: int) -> tuple[optax.Grad
     return tx, schedule
 
 
+def make_stop_flags(mesh: jax.sharding.Mesh, flag: bool) -> jax.Array:
+    """Per-process preemption votes as a device array, one int32 element per
+    device (this process writes ``flag`` to each of its local devices).
+    ``jnp.max`` over it inside the jitted step is the cross-process stop
+    agreement — GSPMD emits the all-reduce, no host collective exists."""
+    sharding = NamedSharding(mesh, P(mesh.axis_names))
+    val = np.asarray([1 if flag else 0], dtype=np.int32)
+    arrays = [jax.device_put(val, d) for d in mesh.local_devices]
+    return jax.make_array_from_single_device_arrays(
+        (mesh.devices.size,), sharding, arrays
+    )
+
+
 def make_train_step(
     task: Task,
     tx: optax.GradientTransformation,
     schedule: optax.Schedule,
     accum_steps: int = 1,
-) -> Callable[[TrainState, dict[str, jax.Array]], tuple[TrainState, dict[str, jax.Array]]]:
+    with_stop: bool = False,
+) -> Callable[..., tuple[TrainState, dict[str, jax.Array]]]:
     """Build the jitted SPMD train step.
+
+    ``with_stop=True`` (multi-process runs) adds a third argument — the
+    :func:`make_stop_flags` votes array — and a ``stop_agreed`` entry in
+    the metrics: the device-side reduction of the fleet's preemption
+    votes. The votes array is NOT donated: the trainer prebuilds one
+    array per flag value and re-passes it every step, so the steady state
+    pays zero per-step H2D transfers. The loop reads the agreement
+    through the bounded dispatch-depth barrier, so stop agreement costs
+    zero blocking host collectives (the old ``--preempt_sync_steps``
+    allgather cadence).
 
     Batch layout: ``(global_batch, ...)`` sharded over ``data`` when
     ``accum_steps == 1``; ``(accum, micro, ...)`` sharded over ``data`` on
@@ -136,7 +171,8 @@ def make_train_step(
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
-    def step_fn(state: TrainState, batch: dict[str, jax.Array]):
+    def step_fn(state: TrainState, batch: dict[str, jax.Array],
+                stop_flags: jax.Array | None = None):
         rng = jax.random.fold_in(state.rng, state.step)
 
         if accum_steps == 1:
@@ -187,6 +223,12 @@ def make_train_step(
         out_metrics.setdefault("loss", loss)
         out_metrics["grad_norm"] = grad_norm
         out_metrics["lr"] = schedule(state.step)
+        if stop_flags is not None:
+            # device-side stop agreement: OR of every process's vote.
+            # Replicated output — each host reads the identical value, so
+            # all hosts observing it at the same lagged iteration take the
+            # identical stop decision at the identical global_step.
+            out_metrics["stop_agreed"] = jnp.max(stop_flags)
         return new_state, out_metrics
 
     return jax.jit(step_fn, donate_argnums=(0,))
@@ -240,8 +282,18 @@ class Trainer:
         self.steps_per_epoch = steps_per_epoch
 
         self.tx, self.schedule = make_optimizer(config, self.total_steps)
+        # multi-process runs carry the preemption-stop agreement inside the
+        # jitted step (device-side reduction of per-process votes);
+        # single-process runs keep the two-arg signature and act on the
+        # local flag directly — no device work for a host-local decision
+        self._with_stop = jax.process_count() > 1
+        # prebuilt per-flag vote arrays (built on first use): the votes
+        # input is re-passed, never donated, so the hot loop performs no
+        # per-step H2D transfer for stop agreement
+        self._stop_votes: dict[bool, jax.Array] = {}
         self.train_step = make_train_step(
-            task, self.tx, self.schedule, config.gradient_accumulation_steps
+            task, self.tx, self.schedule, config.gradient_accumulation_steps,
+            with_stop=self._with_stop,
         )
         self.eval_step = make_eval_step(task)
         self.ckpt = CheckpointManager(
@@ -249,6 +301,11 @@ class Trainer:
             max_to_keep=config.keep_checkpoints or None,
         )
         self.metrics_writer = MetricsWriter(config.output_dir)
+        self.telemetry = make_telemetry(config.telemetry, self.metrics_writer)
+        # shared with bench.py's e2e full-loop leg: steady-state step-time
+        # percentiles with side-work intervals discarded
+        self.step_timer = StepTimer()
+        self.divergence = DivergenceMonitor(lag=max(config.max_inflight_steps, 1))
 
     # -- state ------------------------------------------------------------
     def init_state(self) -> TrainState:
@@ -390,6 +447,10 @@ class Trainer:
         try:
             return self._train_loop(state, start_step, stop_signal)
         finally:
+            # telemetry first: flush every queued scalar (incl. the final
+            # interval when the loop raised) before the writer closes
+            self.telemetry.close()
+            self.metrics_writer.close()
             # restore only AFTER the preemption checkpoint is durably
             # written: schedulers re-deliver SIGTERM during the grace
             # window, and a default handler mid-save would defeat the
@@ -399,32 +460,25 @@ class Trainer:
                               prev_handler if prev_handler is not None
                               else signal.SIG_DFL)
 
-    def _stop_agreed(self, stop_signal, global_step: int) -> bool:
-        """True when the whole fleet has agreed to stop at this step.
+    def _dispatch(self, state, batch, stop_signal=None):
+        """Dispatch one jitted step; returns ``(state, metrics, fence)``.
 
-        Single-process: stop as soon as the local flag is set.
-        Multi-process: SLURM/TPU-VM maintenance SIGTERMs every host at
-        arbitrary skew, so a host acting on its local flag alone would
-        break out at its own global_step — and the cross-process
-        checkpoint save (a collective) would hang against peers still
-        running train steps, or record mismatched steps. Instead hosts
-        exchange flags at a fixed step cadence (``--preempt_sync_steps``)
-        and all observe the same decision at the same global_step.
-        """
-        local = stop_signal["sig"] is not None
-        if jax.process_count() == 1:
-            return local
-        if global_step % max(self.config.preempt_sync_steps, 1):
-            return False
-        from jax.experimental import multihost_utils
-
-        flags = np.asarray(multihost_utils.process_allgather(
-            np.asarray([1 if local else 0], np.int32)
-        )).reshape(-1)
-        if flags.any() and not local:
-            # a peer was signalled; record it so the stop log is honest
-            stop_signal["sig"] = int(signal.SIGTERM)
-        return bool(flags.any())
+        ``fence`` is the device scalar the bounded-depth barrier reads K
+        iterations later: the cross-process stop agreement on multi-process
+        runs, else the (already produced) loss. Shared with bench.py's e2e
+        full-loop leg so the bench drives the exact production dispatch
+        path."""
+        if self._with_stop:
+            local = stop_signal is not None and stop_signal["sig"] is not None
+            votes = self._stop_votes.get(local)
+            if votes is None:
+                votes = self._stop_votes[local] = make_stop_flags(
+                    self.ctx.mesh, local
+                )
+            state, metrics = self.train_step(state, batch, votes)
+            return state, metrics, metrics.pop("stop_agreed")
+        state, metrics = self.train_step(state, batch)
+        return state, metrics, metrics["loss"]
 
     def _train_loop(self, state, start_step, stop_signal):
         cfg = self.config
@@ -438,12 +492,29 @@ class Trainer:
             except ImportError:
                 pbar = None
 
+        telemetry = self.telemetry
+
+        def _on_write(kind, step, host):  # runs on the telemetry thread
+            log.info(kind, {"step": step, **host})
+
+        telemetry.on_write = _on_write
+
         window: list[jax.Array] = []
         side_work = False  # True when the last iteration ran eval/save/etc.
         trace = TraceWindow(cfg.output_dir, start_step=start_step + 10,
                             num_steps=cfg.profile_steps)
-        timer = StepTimer()
+        timer = self.step_timer
+        # Bounded dispatch depth: (step, fence) for the last K dispatches.
+        # Reading the fence of step N-K each iteration is the loop's ONLY
+        # host<->device sync — a scalar from a step that has already
+        # retired in steady state, so it paces without stalling. In the
+        # sync-telemetry before-mode on single-process runs the barrier is
+        # off, reproducing the pre-async loop exactly.
+        max_inflight = max(cfg.max_inflight_steps, 1)
+        paced = self._with_stop or not isinstance(telemetry, SyncTelemetry)
+        inflight: deque[tuple[int, jax.Array]] = deque()
         t_last = time.perf_counter()
+        wait_last = self.loader.stats["consumer_wait_s"]
         examples_per_step = cfg.train_batch_size * cfg.gradient_accumulation_steps
         start_epoch = start_step // self.steps_per_epoch
         global_step = start_step
@@ -455,55 +526,108 @@ class Trainer:
             skip = start_step % self.steps_per_epoch if epoch == start_epoch else 0
             for batch in self.loader.epoch(epoch, start_batch=skip):
                 trace.step(global_step)
-                state, metrics = self.train_step(state, batch)
+                state, metrics, fence = self._dispatch(state, batch, stop_signal)
                 # an interval that included eval/save/divergence work last
                 # iteration is not a step time — keep percentiles honest
                 timer.tick(discard=side_work)
                 side_work = False
                 global_step += 1
+                inflight.append((global_step, fence))
                 if cfg.logging_steps:  # window only consumed when logging
                     window.append(metrics["loss"])
                 if pbar is not None:
                     pbar.update(1)
 
+                stop_now = False
+                if paced:
+                    while len(inflight) > max_inflight:
+                        _, fval = inflight.popleft()
+                        # the barrier: one scalar host read of a step K
+                        # dispatches old — complete in steady state
+                        fval = jax.device_get(fval)
+                        if self._with_stop and int(fval):
+                            stop_now = True
+                else:
+                    while len(inflight) > max_inflight:
+                        inflight.popleft()
+                if not self._with_stop and stop_signal["sig"] is not None:
+                    # host-local decision; no device round-trip involved
+                    stop_now = True
+
                 if cfg.logging_steps and global_step % cfg.logging_steps == 0:
-                    mean_loss = float(jnp.mean(jnp.stack(window)))
-                    window.clear()
+                    if isinstance(telemetry, SyncTelemetry):
+                        # pre-async behaviour, kept bit-faithful for the
+                        # host_overhead_pct before-leg: device mean, then
+                        # the sink's inline float() blocks on the step
+                        loss_val: Any = jnp.mean(jnp.stack(window))
+                        timer_val: Any = timer.summary()
+                    else:
+                        # hand the raw per-step device scalars to the
+                        # drain thread (it averages after device_get) and
+                        # defer the percentile math over a snapshot taken
+                        # NOW: zero extra dispatches, zero numpy on the
+                        # hot loop, and the record stays tied to its step
+                        # even if the drain lags
+                        loss_val = window
+                        timer_val = timer.deferred_summary()
+                    window = []  # the sink owns the old list now
                     now = time.perf_counter()
                     steps_per_s = cfg.logging_steps / (now - t_last)
                     t_last = now
+                    wait_now = self.loader.stats["consumer_wait_s"]
                     scalars = {
-                        "loss": mean_loss,
-                        "lr": float(metrics["lr"]),
-                        "grad_norm": float(metrics["grad_norm"]),
+                        "loss": loss_val,
+                        "lr": metrics["lr"],
+                        "grad_norm": metrics["grad_norm"],
                         "steps_per_sec": steps_per_s,
                         "examples_per_sec": steps_per_s * examples_per_step,
-                        **timer.summary(),
+                        "input_wait_ms": 1e3 * (wait_now - wait_last)
+                        / cfg.logging_steps,
+                        "timer": timer_val,
                     }
-                    self.metrics_writer.write(global_step, scalars)
-                    if pbar is not None:
-                        pbar.set_postfix(loss=f"{mean_loss:.4f}")
-                    log.info("progress", {"step": global_step, **scalars})
+                    wait_last = wait_now
+                    telemetry.emit(global_step, scalars, kind="progress")
+                    # snapshot: the drain thread rebinds .latest (possibly
+                    # to an eval record with no 'loss') between a check
+                    # and an index
+                    latest = telemetry.latest
+                    if pbar is not None and "loss" in latest:
+                        # lagged by design: the async contract trades a
+                        # stale postfix for an unstalled dispatch pipeline
+                        pbar.set_postfix(loss=f"{latest['loss']:.4f}")
 
                 if cfg.eval_steps and global_step % cfg.eval_steps == 0:
                     side_work = True
                     ev = self.evaluate(state)
                     if ev:
-                        self.metrics_writer.write(global_step, ev)
-                        log.info("eval", {"step": global_step, **ev})
+                        telemetry.emit(global_step, ev, kind="eval")
 
                 if (cfg.divergence_check_steps
                         and global_step % cfg.divergence_check_steps == 0):
-                    # SPMD desync detector (utils/divergence.py): replicated
-                    # state must fingerprint identically on every host
-                    side_work = True
-                    divergence_check(state.params, step=global_step)
+                    # SPMD desync detector: dispatch the fingerprint now
+                    # (async); the fetch+allgather completes via poll() once
+                    # it is max_inflight steps old — off the critical path
+                    self.divergence.submit(state.params, global_step)
+                if self.divergence.poll(global_step) is not None:
+                    side_work = True  # the DCN allgather ran this iteration
 
                 if cfg.save_steps and global_step % cfg.save_steps == 0:
-                    side_work = True
+                    # async orbax save: schedule-and-return. Only discard
+                    # the next timer interval if scheduling actually
+                    # stalled (e.g. waiting out the previous save) — an
+                    # unconditional discard would blind the percentiles to
+                    # every save-adjacent step
+                    t_save = time.perf_counter()
                     self.ckpt.save(global_step, state, cfg)
+                    save_ms = 1e3 * (time.perf_counter() - t_save)
+                    p50 = timer.p50_ms() if self.ckpt.is_async else None
+                    side_work = side_work or p50 is None or \
+                        save_ms > max(0.25 * p50, 1.0)
 
-                if self._stop_agreed(stop_signal, global_step):
+                if stop_now:
+                    if stop_signal["sig"] is None:
+                        # a peer was signalled; record it so the log is honest
+                        stop_signal["sig"] = int(signal.SIGTERM)
                     log.warning(
                         "termination signal received — checkpointing and "
                         "exiting for clean resume",
@@ -521,9 +645,9 @@ class Trainer:
         if pbar is not None:
             pbar.close()
         trace.close()
+        self.divergence.drain()  # identical pending set on every process
         if self.ckpt.latest_step() != global_step:  # avoid duplicate final save
             self.ckpt.save(global_step, state, cfg, force=True)
         self.ckpt.wait()
-        self.metrics_writer.close()
         log.info("training complete", {"global_step": global_step})
         return state

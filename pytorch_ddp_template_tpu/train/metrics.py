@@ -11,14 +11,29 @@ only. Two fixes over the reference:
   true mean over optimizer steps (accumulation is inside the jitted step).
 - scalars also go to a ``metrics.jsonl`` file, so runs are machine-readable
   without TB and the bench harness can consume them directly.
+
+On top of the writer sit the telemetry sinks the train loop emits into:
+
+- :class:`AsyncTelemetry` (default) accepts *device arrays* and drains them
+  on a background thread via ``jax.device_get`` — emitting at a logging
+  boundary never blocks the loop on the in-flight step, so ``logging_steps``
+  stops being a hidden host-sync cadence. Scalars may therefore land in
+  TB/JSONL up to one interval after their step; step keys are unchanged.
+- :class:`SyncTelemetry` (``--telemetry sync``) reproduces the pre-async
+  behaviour — inline host conversion, blocking on the in-flight step — and
+  exists as the measured "before" leg of ``host_overhead_pct`` in
+  ``BENCH_MODE=e2e`` (BENCH.md).
 """
 
 from __future__ import annotations
 
 import json
+import queue
+import threading
 import time
+from collections.abc import Mapping
 from pathlib import Path
-from typing import Any
+from typing import Any, Callable
 
 from ..utils import get_logger, is_main_process
 
@@ -59,3 +74,157 @@ class MetricsWriter:
         self._jsonl.close()
         if self._tb is not None:
             self._tb.close()
+
+
+def _fetch(v: Any) -> float:
+    import jax
+
+    return float(jax.device_get(v)) if isinstance(v, jax.Array) else float(v)
+
+
+def _to_host(scalars: dict[str, Any]) -> dict[str, float]:
+    """Resolve an emitted record to host floats (blocking). Values may be:
+
+    - a device array or host number → fetched/cast;
+    - a list/tuple of either → fetched and MEANED (the loss window rides
+      as raw per-step device scalars; the mean belongs on the drain
+      thread, not as extra dispatches on the hot loop);
+    - a zero-arg callable → called here, returning a float or a flat dict
+      merged into the record (``StepTimer.summary`` percentiles are numpy
+      work the hot loop should not pay).
+    """
+    out: dict[str, float] = {}
+    for k, v in scalars.items():
+        if callable(v):
+            v = v()
+        if isinstance(v, Mapping):
+            out.update({k2: _fetch(v2) for k2, v2 in v.items()})
+        elif isinstance(v, (list, tuple)):
+            vals = [_fetch(x) for x in v]
+            out[k] = sum(vals) / len(vals) if vals else 0.0
+        else:
+            out[k] = _fetch(v)
+    return out
+
+
+#: callback signature: (kind, step, host_scalars) — runs on whichever thread
+#: performed the host conversion (the drain thread for AsyncTelemetry)
+OnWrite = Callable[[str, int, dict[str, float]], None]
+
+
+class SyncTelemetry:
+    """Inline sink: convert-and-write at emit time, blocking on the
+    in-flight step. This is the pre-async loop behaviour, kept selectable
+    (``--telemetry sync``) as the before-measurement for
+    ``host_overhead_pct`` — it converts on every process (as the old loop
+    did), not just where the writer is active."""
+
+    def __init__(self, writer: MetricsWriter):
+        self.writer = writer
+        self.latest: dict[str, float] = {}
+        self.on_write: OnWrite | None = None
+
+    def emit(self, step: int, scalars: dict[str, Any],
+             kind: str = "progress") -> None:
+        host = _to_host(scalars)
+        self.latest = host
+        self.writer.write(step, host)
+        if self.on_write is not None:
+            self.on_write(kind, step, host)
+
+    def close(self) -> None:
+        pass
+
+
+class AsyncTelemetry:
+    """Background sink: ``emit`` enqueues device arrays and returns without
+    touching them; a drain thread does the ``jax.device_get`` and the
+    TB/JSONL writes. The hot loop therefore never blocks on a logging
+    boundary — by the time the drain thread fetches a scalar, the step that
+    produced it has long retired, so even the fetch is cheap.
+
+    Delivery contract: every emitted record is written exactly once, in
+    emission order, before :meth:`close` returns — including when training
+    crashes (the trainer closes the sink in a ``finally``), so the final
+    interval's scalars are never dropped. ``latest`` exposes the most
+    recently drained record (used for the lagged tqdm postfix)."""
+
+    _SENTINEL = object()
+
+    def __init__(self, writer: MetricsWriter, *, maxsize: int = 256):
+        self.writer = writer
+        self.latest: dict[str, float] = {}
+        self.on_write: OnWrite | None = None
+        # bounded: if the writer ever falls an entire queue behind, emit
+        # blocks rather than growing host buffers without limit
+        self._q: queue.Queue = queue.Queue(maxsize=maxsize)
+        self._closed = False
+        # lazy: the drain thread starts on first emit, so a Trainer that
+        # never logs (logging_steps=0, bench legs, eval-only) holds no
+        # live thread to leak when it is dropped without close()
+        self._thread: threading.Thread | None = None
+
+    def emit(self, step: int, scalars: dict[str, Any],
+             kind: str = "progress") -> None:
+        if self._closed:  # late emit (e.g. from a finally): write inline
+            self._write_one(kind, int(step), dict(scalars))
+            return
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._drain, daemon=True, name="telemetry-drain"
+            )
+            self._thread.start()
+        self._q.put((kind, int(step), dict(scalars)))
+
+    def _write_one(self, kind: str, step: int, scalars: dict[str, Any]) -> None:
+        if not self.writer.active and self.on_write is None:
+            return  # non-main process: nothing consumes the conversion
+        try:
+            host = _to_host(scalars)
+            self.latest = host
+            self.writer.write(step, host)
+            if self.on_write is not None:
+                self.on_write(kind, step, host)
+        except Exception:  # noqa: BLE001 - telemetry must never kill training
+            log.exception("telemetry write failed (record dropped)")
+
+    def _drain(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is self._SENTINEL:
+                return
+            self._write_one(*item)
+
+    def close(self) -> None:
+        """Flush everything queued, then stop the drain thread. Idempotent;
+        safe to call from exception handlers — any records the thread did
+        not get to are drained inline so nothing is lost."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._thread is not None:
+            self._q.put(self._SENTINEL)
+            self._thread.join(timeout=60)
+            if self._thread.is_alive():
+                # drain thread wedged (hung filesystem / TB write): it
+                # still owns the queue — draining here too would interleave
+                # two writers and could swallow its sentinel, parking it on
+                # q.get() forever. Leave the queue to it.
+                log.error("telemetry drain thread did not stop within 60s; "
+                          "queued records may be delayed")
+                return
+        while True:  # thread never started or died mid-queue: finish its work
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not self._SENTINEL:
+                self._write_one(*item)
+
+
+def make_telemetry(kind: str, writer: MetricsWriter) -> SyncTelemetry | AsyncTelemetry:
+    if kind == "async":
+        return AsyncTelemetry(writer)
+    if kind == "sync":
+        return SyncTelemetry(writer)
+    raise ValueError(f"unknown telemetry mode {kind!r}; expected async|sync")

@@ -40,11 +40,9 @@ def fingerprint(tree: Any) -> jax.Array:
     return jnp.concatenate([sums, norms])
 
 
-def check(tree: Any, *, step: int | None = None, raise_on_divergence: bool = False) -> bool:
-    """True iff every process holds a bit-identical fingerprint of ``tree``."""
-    if jax.process_count() == 1:
-        return True  # before fingerprinting: don't stall async dispatch
-    fp = np.asarray(fingerprint(tree))
+def _compare(fp: np.ndarray, *, step: int | None,
+             raise_on_divergence: bool) -> bool:
+    """Allgather ``fp`` across processes and compare bit patterns."""
     from jax.experimental import multihost_utils
 
     all_fps = np.asarray(multihost_utils.process_allgather(fp))
@@ -66,3 +64,58 @@ def check(tree: Any, *, step: int | None = None, raise_on_divergence: bool = Fal
             raise RuntimeError(f"cross-host parameter divergence: {detail}")
         log.error("cross-host parameter divergence detected", detail)
     return ok
+
+
+def check(tree: Any, *, step: int | None = None, raise_on_divergence: bool = False) -> bool:
+    """True iff every process holds a bit-identical fingerprint of ``tree``."""
+    if jax.process_count() == 1:
+        return True  # before fingerprinting: don't stall async dispatch
+    return _compare(np.asarray(fingerprint(tree)), step=step,
+                    raise_on_divergence=raise_on_divergence)
+
+
+class DivergenceMonitor:
+    """:func:`check` with the device fetch taken off the critical path.
+
+    ``submit`` only *dispatches* the jitted fingerprint reduction (async,
+    returns immediately); ``poll`` completes a pending check once its
+    fingerprint is at least ``lag`` steps old — by which point the
+    reduction has retired behind later train steps, so the host fetch
+    costs ~nothing. Only the DCN allgather remains on the main thread
+    (collectives must issue in identical order on every process, so it
+    cannot move to a background thread), and every process polls the same
+    deterministic schedule, keeping the allgathers matched.
+
+    Single-process meshes are a no-op end to end, like :func:`check`.
+    """
+
+    def __init__(self, *, lag: int = 2, raise_on_divergence: bool = False):
+        self.lag = max(int(lag), 1)
+        self.raise_on_divergence = raise_on_divergence
+        self.ok = True
+        self._pending: list[tuple[int, jax.Array]] = []
+
+    def submit(self, tree: Any, step: int) -> None:
+        if jax.process_count() == 1:
+            return
+        self._pending.append((step, fingerprint(tree)))
+
+    def _complete_first(self) -> bool:
+        step, fp = self._pending.pop(0)
+        ok = _compare(np.asarray(fp), step=step,
+                      raise_on_divergence=self.raise_on_divergence)
+        self.ok = self.ok and ok
+        return ok
+
+    def poll(self, current_step: int) -> bool | None:
+        """Complete the oldest pending check if it is ripe; None if no
+        check ran this call (nothing pending, or still within ``lag``)."""
+        if not self._pending or current_step - self._pending[0][0] < self.lag:
+            return None
+        return self._complete_first()
+
+    def drain(self) -> bool:
+        """Complete every pending check (call before leaving the loop)."""
+        while self._pending:
+            self._complete_first()
+        return self.ok

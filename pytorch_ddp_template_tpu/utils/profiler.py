@@ -87,13 +87,34 @@ class StepTimer:
         self._last = now
         return dt
 
-    def summary(self) -> dict[str, float]:
+    def p50_ms(self) -> float | None:
+        """Median recorded step time in ms (None before any sample) —
+        cheap yardstick for "did this side-work call actually stall?"."""
         if not self._times:
+            return None
+        return float(np.percentile(np.asarray(self._times), 50) * 1e3)
+
+    @staticmethod
+    def _summarize(times) -> dict[str, float]:
+        if not times:
             return {}
-        arr = np.asarray(self._times)
+        arr = np.asarray(times)
         return {
             "step_time_p50_ms": float(np.percentile(arr, 50) * 1e3),
             "step_time_p90_ms": float(np.percentile(arr, 90) * 1e3),
             "step_time_p99_ms": float(np.percentile(arr, 99) * 1e3),
             "step_time_mean_ms": float(arr.mean() * 1e3),
         }
+
+    def summary(self) -> dict[str, float]:
+        return self._summarize(self._times)
+
+    def deferred_summary(self):
+        """Zero-arg callable computing :meth:`summary` over a snapshot of
+        the samples *as of now*. The copy is a cheap C-level list copy (no
+        numpy on the caller); the percentile math runs wherever the
+        callable is invoked (the telemetry drain thread) — and reports the
+        state at snapshot time, not whatever the timer holds when a lagging
+        drain finally gets to the record."""
+        times = tuple(self._times)
+        return lambda: self._summarize(times)

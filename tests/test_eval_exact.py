@@ -181,6 +181,7 @@ class TestEvaluateExact:
 
 
 class TestEvaluateExactContextParallel:
+    @pytest.mark.slow  # heavy long-tail: outside the budgeted tier-1 run
     def test_weighted_eval_on_seq_mesh(self, tmp_path):
         """Exactly-once eval composed with context parallelism: holdout of
         37 on a data:2,seq:2 mesh (batch 8) — weights shard over data,

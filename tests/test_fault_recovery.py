@@ -21,7 +21,10 @@ REPO = Path(__file__).resolve().parent.parent
 SCRIPT = """
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 1)
+try:  # jax >= 0.5 spelling; older jax defaults to 1 CPU device anyway
+    jax.config.update("jax_num_cpu_devices", 1)
+except AttributeError:
+    pass
 import json, os
 import numpy as np
 

@@ -102,6 +102,7 @@ def test_augment_on_device():
     assert np.isfinite(float(le))  # eval path: no augmentation, no rng
 
 
+@pytest.mark.slow  # heavy long-tail: outside the budgeted tier-1 run
 def test_resnet18_trains_from_disk(tmp_path):
     from pytorch_ddp_template_tpu.train.engine import Trainer
 
@@ -164,6 +165,7 @@ def test_gpt_trains_from_token_store(tmp_path):
     assert int(state.step) == 3
 
 
+@pytest.mark.slow  # heavy long-tail: outside the budgeted tier-1 run
 def test_padded_long_model_trains_from_token_store(tmp_path):
     """The long-context (padded) families consume attention_mask from the
     store; the mask key is required and the Trainer runs from disk."""

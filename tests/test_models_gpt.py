@@ -37,6 +37,7 @@ def test_causality_invariant(impl):
     assert not np.allclose(base[:, 40:], out2[:, 40:], atol=1e-4)
 
 
+@pytest.mark.slow  # heavy long-tail: outside the budgeted tier-1 run
 def test_gpt_context_parallel_end_to_end(tmp_path):
     """gpt-long-tiny (causal ring attention) through the full Trainer on a
     data×seq mesh; causality holds under sequence sharding."""

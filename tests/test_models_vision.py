@@ -66,6 +66,7 @@ class TestResNetModule:
         trunk_s = {k: v for k, v in vs["params"].items() if "block" in k}
         assert jax.tree.structure(trunk_b) == jax.tree.structure(trunk_s)
 
+    @pytest.mark.slow  # heavy long-tail: outside the budgeted tier-1 run
     def test_remat_matches_no_remat_forward_and_grad(self):
         """Rematerialised blocks must be a pure scheduling change: identical
         logits, identical gradients, and the BatchNorm mutable collection
@@ -151,6 +152,7 @@ class TestRegistryVision:
         assert state.extra_vars and "batch_stats" in state.extra_vars
 
 
+@pytest.mark.slow  # heavy long-tail: outside the budgeted tier-1 run
 def test_selective_remat_matches_no_remat():
     """--remat_policy save-convs: saving conv outputs by name and
     recomputing only norm/ReLU must leave loss AND grads bit-comparable

@@ -52,6 +52,7 @@ class TestMoeBlock:
 
 
 class TestMoeTraining:
+    @pytest.mark.slow  # heavy long-tail: outside the budgeted tier-1 run
     def test_trains_on_expert_mesh(self, tmp_path):
         """Full engine over data:2,expert:4 (one expert per rank, so the
         all_to_all dispatch path is live in the hot loop) — sharded
@@ -66,6 +67,7 @@ class TestMoeTraining:
         k = len(losses) // 4
         assert sum(losses[-k:]) / k < sum(losses[:k]) / k, losses
 
+    @pytest.mark.slow  # heavy long-tail: outside the budgeted tier-1 run
     def test_expert_weights_sharded_over_expert_axis(self, tmp_path):
         t = make_trainer(tmp_path, "data:2,expert:4")
         state, _ = t.restore_or_init()

@@ -176,6 +176,7 @@ def test_ulysses_rejects_indivisible_heads():
         ulysses_attention(q, q, q, mesh)
 
 
+@pytest.mark.slow  # heavy long-tail: outside the budgeted tier-1 run
 def test_ulysses_end_to_end(tmp_path):
     """bert-long-tiny with cp_impl=ulysses trains through the Trainer on a
     data×seq mesh, padded batches included."""

@@ -137,6 +137,7 @@ class TestEvalOnly:
             ddp.main(_args(tmp_path / "fresh",
                            ["--eval_only", "--max_steps", "4"]))
 
+    @pytest.mark.slow  # heavy long-tail: outside the budgeted tier-1 run
     def test_eval_only_tail_holdout_leak_rejected(self, tmp_path):
         """A training run that used the WHOLE file store (eval_steps=0)
         must not later have its tail rows presented as held-out."""

@@ -5,9 +5,11 @@ SIGTERM to *every* host, at arbitrary skew — and a host that acts on its
 local flag alone breaks out of the loop at its own global_step, leaving
 its peer stuck in collective train steps against nobody (the reference's
 pre-elastic launcher simply dies, SURVEY.md §5.3). Here only process 0 is
-signalled; the ``--preempt_sync_steps`` agreement protocol
-(``train/engine.py::Trainer._stop_agreed``) must stop BOTH processes at
-the same step and land one coherent cross-process checkpoint.
+signalled; the *device-side* agreement (per-process stop votes reduced
+inside the jitted step, ``train/engine.py::make_stop_flags`` — no host
+allgather cadence exists anymore) must spread the vote and stop BOTH
+processes at the same global step, landing one coherent cross-process
+checkpoint.
 
 Writes ``preempt_result_<proc>.json``; exit code 0 iff training exited
 cleanly through the preemption path.
@@ -52,7 +54,7 @@ def main() -> int:
         max_steps=100_000,  # unreachable: only SIGTERM ends this run
         logging_steps=4,
         save_steps=0,
-        preempt_sync_steps=4,
+        max_inflight_steps=2,  # stop must land within 2 steps of the vote
         model="mlp",
     )
     ctx = init(cfg)
