@@ -16,7 +16,9 @@ UNAVAILABLE — init retries with backoff, and ANY hard failure still emits a
 single parseable JSON line (``value: 0`` + ``error``) instead of a stack
 trace. Env knobs: BENCH_MODEL / BENCH_STEPS / BENCH_WARMUP / BENCH_BATCH /
 BENCH_CPU=1 (force the CPU backend — the axon TPU plugin ignores the
-JAX_PLATFORMS env var, so tests must force via the config API).
+JAX_PLATFORMS env var, so tests must force via the config API) /
+BENCH_SCAN=1 + BENCH_DEPTH=N (scan-over-layers and deep-model variants of
+the train mode) / BENCH_DEPTHS (the compile mode's depth sweep).
 """
 
 from __future__ import annotations
@@ -52,7 +54,7 @@ PEAK_FLOPS = {
     "TPU v2": 45e12,
 }
 
-MODE = os.environ.get("BENCH_MODE", "train")  # train | e2e | scaling | flash
+MODE = os.environ.get("BENCH_MODE", "train")  # train | e2e | scaling | flash | compile
 MODEL = os.environ.get("BENCH_MODEL", "resnet50")
 WARMUP_STEPS = int(os.environ.get("BENCH_WARMUP", "5"))
 TIMED_STEPS = int(os.environ.get("BENCH_STEPS", "30"))
@@ -69,9 +71,11 @@ def _emit(payload: dict) -> None:
 
 
 #: record keys that mark an ablation run — numbers taken with a lever
-#: deliberately degraded (or a kernel disabled) must never be cited as the
-#: best-known HEADLINE config during an outage
-ABLATION_KEYS = ("remat", "fused_head", "dense_head", "flash_disabled")
+#: deliberately degraded (or a kernel disabled, or the model's depth
+#: changed via BENCH_DEPTH) must never be cited as the best-known
+#: HEADLINE config during an outage
+ABLATION_KEYS = ("remat", "fused_head", "dense_head", "flash_disabled",
+                 "num_layers", "scan_layers")
 
 
 def _last_recorded(metric: str) -> dict | None:
@@ -311,6 +315,18 @@ def run_bench(model: str, metric: str, unit: str, baseline: float,
         if not hasattr(task.model, "fused_head"):
             raise ValueError(f"BENCH_DENSE_HEAD: model {model!r} has no LM head")
         task.model = task.model.clone(fused_head=False)
+    depth = int(os.environ.get("BENCH_DEPTH", "0"))  # deep-model variants
+    if depth:
+        if not hasattr(task.model, "num_layers"):
+            raise ValueError(f"BENCH_DEPTH: model {model!r} has no num_layers")
+        task.model = task.model.clone(num_layers=depth)
+    scan = os.environ.get("BENCH_SCAN", "") == "1"  # scan-over-layers leg
+    if scan:
+        if not hasattr(task.model, "scan_layers"):
+            raise ValueError(
+                f"BENCH_SCAN: model {model!r} has no transformer layer stack"
+            )
+        task.model = task.model.clone(scan_layers=True)
 
     global_batch = per_device * n_dev
     idx = np.arange(global_batch) % len(dataset)
@@ -372,6 +388,10 @@ def run_bench(model: str, metric: str, unit: str, baseline: float,
         out["fused_head"] = True
     if dense_head:
         out["dense_head"] = True
+    if depth:
+        out["num_layers"] = depth  # ablation-keyed: not the headline model
+    if scan:
+        out["scan_layers"] = True
     if os.environ.get("FLASH_DISABLE", "") == "1":
         out["flash_disabled"] = True
     try:  # compiled-executable memory breakdown (peak-memory evidence for
@@ -538,6 +558,135 @@ def run_e2e(model: str, metric: str, unit: str, baseline: float) -> dict:
         "host_overhead_pct": round(
             100 * (cached["value"] - full_per_chip) / cached["value"], 2
         ) if cached["value"] else None,
+    }
+
+
+def run_compile() -> dict:
+    """Scan-over-layers compile-time proof: cold ``jit(...).lower().compile()``
+    wall-time of the full train step, unrolled vs scanned, across depths.
+
+    Unrolled, XLA traces and optimises ``num_layers`` copies of the same
+    block, so compile time grows ~linearly in depth; scanned
+    (``--scan_layers``), one block body is compiled and ``lax.scan`` drives
+    it, so compile time is ~flat. Deterministic on the CPU bench host —
+    compile wall-time needs no TPU, which is why this leg can commit a
+    before/after pair during a tunnel outage. A steady-state step-time leg
+    at the deepest depth (alternating reps, min-of-reps against ambient
+    load) checks the scan is throughput-neutral. Knobs: ``BENCH_DEPTHS``
+    (default "2,12,24"), ``BENCH_BATCH``, ``BENCH_SEQ``, ``BENCH_REMAT=1``
+    (remat-scan vs remat-unrolled), ``BENCH_STEPS``/``BENCH_WARMUP`` for
+    the step-time leg.
+    """
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_ddp_template_tpu.config import TrainingConfig
+    from pytorch_ddp_template_tpu.models.gpt import CausalLmTask, GptDecoder
+    from pytorch_ddp_template_tpu.train.engine import (
+        TrainState,
+        make_optimizer,
+        make_train_step,
+    )
+
+    depths = tuple(int(d) for d in
+                   os.environ.get("BENCH_DEPTHS", "2,12,24").split(","))
+    batch_size = PER_DEVICE_BATCH or 4
+    seq = int(os.environ.get("BENCH_SEQ", "64"))
+    vocab = 256
+    remat = os.environ.get("BENCH_REMAT", "") == "1"
+    ids = np.random.default_rng(0).integers(0, vocab, (batch_size, seq))
+    batch = {"input_ids": jnp.asarray(ids, jnp.int32)}
+    config = TrainingConfig(warmup_steps=0, max_grad_norm=1000.0)
+
+    def build_step(depth: int, scanned: bool):
+        model = GptDecoder(vocab_size=vocab, max_len=seq, num_layers=depth,
+                           num_heads=2, head_dim=32, mlp_dim=128,
+                           remat=remat, scan_layers=scanned)
+        task = CausalLmTask(model)
+        params, extra = task.init(jax.random.PRNGKey(0), batch)
+        params = nn.meta.unbox(params)
+        tx, schedule = make_optimizer(config, total_steps=10_000)
+        state = TrainState(
+            step=jnp.zeros((), jnp.int32), params=params, extra_vars=extra,
+            opt_state=tx.init(params), rng=jax.random.PRNGKey(1),
+        )
+        # fresh jit per call — nothing shares a cache, every timing is cold
+        return make_train_step(task, tx, schedule), state
+
+    rows = []
+    for depth in depths:
+        row = {"depth": depth}
+        for scanned in (False, True):
+            step, state = build_step(depth, scanned)
+            t0 = time.perf_counter()
+            lowered = step.lower(state, batch)
+            t1 = time.perf_counter()
+            lowered.compile()
+            t2 = time.perf_counter()
+            key = "scanned" if scanned else "unrolled"
+            row[f"{key}_trace_s"] = round(t1 - t0, 3)
+            row[f"{key}_compile_s"] = round(t2 - t1, 3)
+            row[f"{key}_total_s"] = round(t2 - t0, 3)
+        row["compile_speedup"] = round(
+            row["unrolled_total_s"] / max(row["scanned_total_s"], 1e-9), 3
+        )
+        rows.append(row)
+
+    # -- steady-state leg at the deepest depth: throughput neutrality -----
+    # compile once per variant (the unrolled deep compile costs ~a minute;
+    # only the timed stepping needs repeating for ambient-load robustness),
+    # then alternate timed reps so load spikes hit both variants alike
+    deepest = max(depths)
+    variants: dict[str, list] = {}
+    for scanned in (False, True):
+        key = "scanned" if scanned else "unrolled"
+        step, state = build_step(deepest, scanned)
+        compiled = step.lower(state, batch).compile()
+        metrics = None
+        for _ in range(WARMUP_STEPS):
+            state, metrics = compiled(state, batch)
+        if metrics is not None:
+            float(metrics["loss"])  # drain warmup before the clock
+        variants[key] = [compiled, state]
+    step_ms = {}
+    for rep in range(3):
+        for key, slot in variants.items():
+            compiled, state = slot
+            t0 = time.perf_counter()
+            for _ in range(TIMED_STEPS):
+                state, metrics = compiled(state, batch)
+            loss = float(metrics["loss"])  # host read = honest fence
+            dt = time.perf_counter() - t0
+            slot[1] = state  # donated input: thread the live buffer
+            assert np.isfinite(loss), f"non-finite loss {loss}"
+            ms = 1e3 * dt / TIMED_STEPS
+            step_ms[key] = min(step_ms.get(key, ms), ms)
+
+    # headline = the DEEPEST depth's row (BENCH_DEPTHS need not be sorted)
+    headline = next(r for r in rows if r["depth"] == deepest)
+    speedup = headline["compile_speedup"]
+    return {
+        "metric": f"scan_compile_speedup_{deepest}L",
+        "value": speedup,
+        "unit": "x_unrolled_compile",
+        # acceptance bar: scanned <= 0.5x unrolled compile at the deepest
+        # depth, i.e. speedup >= 2 (vs_baseline >= 1.0 is the pass mark)
+        "vs_baseline": round(speedup / 2.0, 4),
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "remat": remat,
+        "batch": batch_size,
+        "seq_len": seq,
+        "depths": list(depths),
+        "compile_table": rows,
+        "step_time_unrolled_ms": round(step_ms["unrolled"], 2),
+        "step_time_scanned_ms": round(step_ms["scanned"], 2),
+        "step_time_ratio_scanned_vs_unrolled": round(
+            step_ms["scanned"] / max(step_ms["unrolled"], 1e-9), 3
+        ),
+        "timed_steps": TIMED_STEPS,
     }
 
 
@@ -728,13 +877,16 @@ def main() -> None:
             _emit(run_scaling(model))
         elif MODE == "flash":
             _emit(run_flash())
+        elif MODE == "compile":
+            _emit(run_compile())
         elif MODE == "e2e":
             _emit(run_e2e(model, metric, unit, baseline))
         elif MODE == "train":
             _emit(run_bench(model, metric, unit, baseline))
         else:  # typo'd mode must not masquerade as a train number
             raise ValueError(
-                f"unknown BENCH_MODE {MODE!r}; expected train|e2e|scaling|flash"
+                f"unknown BENCH_MODE {MODE!r}; expected "
+                "train|e2e|scaling|flash|compile"
             )
     except KeyboardInterrupt:  # operator abort is not a value-0 datum
         raise

@@ -105,6 +105,27 @@ class CheckpointManager:
         )
         return restored["config"]
 
+    def restore_raw(self, step: int | None = None) -> tuple[int, Any, dict]:
+        """Template-free restore: ``(step, state_pytree, config_dict)`` with
+        arrays exactly as saved (host-local, no mesh placement).
+
+        The checkpoint-conversion path (``tools/convert_checkpoint.py``
+        restacking between the unrolled ``layer_{i}`` and the scanned
+        stacked-layer layouts) needs the tree as stored — a template would
+        impose the *destination* structure and defeat the conversion."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        restored = self._mngr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(),
+                config=ocp.args.JsonRestore(),
+            ),
+        )
+        return step, restored["state"], restored["config"]
+
     def restore(self, step: int | None, template_state: Any) -> tuple[Any, dict]:
         """Restore ``(state, config_dict)``; ``step=None`` → latest.
 

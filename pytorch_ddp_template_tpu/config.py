@@ -72,6 +72,14 @@ class TrainingConfig:
     #                     subsumes zero1)
     remat: bool = False  # rematerialise blocks (peak-memory for FLOPs trade;
     #                      long-context entries default it on regardless)
+    scan_layers: bool = False  # drive the transformer block stack as ONE
+    #                            nn.scan-compiled block over weights stacked
+    #                            on a leading (num_layers, ...) dim: compile
+    #                            time stops growing with depth; with --remat
+    #                            the checkpoint sits inside the scan body
+    #                            (remat-scan). Checkpoints restack via
+    #                            tools/convert_checkpoint.py; pipe entries
+    #                            excluded (own stage stacking)
     remat_policy: str = "block"  # block = save only block boundaries;
     #                              save-convs = ResNet selective remat (save
     #                              conv outputs, recompute only norm/ReLU)
@@ -220,6 +228,16 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "(ResNet) saves conv outputs by name and recomputes "
                         "only the norm/ReLU chains — cheap elementwise "
                         "recompute for the post-norm activation stores.")
+    p.add_argument("--scan_layers", action="store_true",
+                   help="Scan-over-layers: compile ONE transformer block "
+                        "and drive it over weights stacked on a leading "
+                        "layer dim (nn.scan) — trace/compile time stops "
+                        "growing with depth, and FSDP gets a uniform "
+                        "always-dividable split axis. Composes with "
+                        "--remat (remat-scan: activations saved only at "
+                        "layer boundaries). Transformer families only; "
+                        "checkpoints convert between layouts with "
+                        "tools/convert_checkpoint.py.")
     p.add_argument("--coordinator_address", type=str, default=None)
     p.add_argument("--num_processes", type=int, default=None)
     p.add_argument("--process_id", type=int, default=None)

@@ -41,6 +41,9 @@ class BertEncoder(nn.Module):
     attn_impl: str = "auto"  # Impl | "ring" (context parallelism)
     mesh: jax.sharding.Mesh | None = None
     remat: bool = False
+    # scan-over-layers (models/transformer.py): one compiled block over
+    # (num_layers, ...)-stacked weights — O(1) compile time in depth
+    scan_layers: bool = False
     # blockwise tied MLM head (ops/lm_head.py): return the transformed
     # head hidden states; the task applies table+bias vocab-block-wise,
     # so the (B, T, V) logits tensor never exists
@@ -74,6 +77,7 @@ class BertEncoder(nn.Module):
             attn_impl=self.attn_impl,
             mesh=self.mesh,
             remat=self.remat,
+            scan_layers=self.scan_layers,
             name="encoder",
         )
         self.mlm_ln = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")

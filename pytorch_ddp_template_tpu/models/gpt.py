@@ -40,6 +40,10 @@ class GptDecoder(nn.Module):
     mesh: jax.sharding.Mesh | None = None
     remat: bool = False
     moe_experts: int = 0  # >0: MoE FFN (models/moe.py) in every block
+    # one nn.scan-compiled block over (num_layers, ...)-stacked weights
+    # instead of num_layers unrolled copies: O(1) compile time in depth,
+    # remat-scan memory profile when composed with remat (--scan_layers)
+    scan_layers: bool = False
     # blockwise tied head (ops/lm_head.py): the model returns final hidden
     # states and the task computes cross-entropy vocab-block-wise — the
     # (B, T, V) logits tensor never exists. The memory enabler for the
@@ -76,6 +80,7 @@ class GptDecoder(nn.Module):
             causal=True,
             remat=self.remat,
             moe_experts=self.moe_experts,
+            scan_layers=self.scan_layers,
             name="decoder",
         )(x, train=train)
         x = nn.LayerNorm(dtype=jnp.float32, name="final_ln")(x)

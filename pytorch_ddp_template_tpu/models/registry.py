@@ -70,6 +70,23 @@ def build(name: str, config: TrainingConfig, mesh=None) -> tuple[Task, Dataset]:
                 f"({type(task.model).__name__}) has no LM head"
             )
         task.model = task.model.clone(fused_head=True)
+    if config.scan_layers:
+        if name.startswith("gpt-pipe"):
+            # the pipelined entries already stack their blocks per STAGE
+            # over the pipe axis (models/gpt_pipe.py) — a second, per-layer
+            # scan would fight that layout
+            raise ValueError(
+                f"--scan_layers: model {name!r} runs its block stack as a "
+                "GPipe pipeline with its own per-stage weight stacking; "
+                "drop --scan_layers or use a non-pipe entry"
+            )
+        if not hasattr(task.model, "scan_layers"):
+            raise ValueError(
+                f"--scan_layers: model {name!r} "
+                f"({type(task.model).__name__}) has no transformer layer "
+                "stack to scan (transformer families only)"
+            )
+        task.model = task.model.clone(scan_layers=True)
     if config.data_dir:
         from ..data.filestore import MemmapDataset
 

@@ -32,8 +32,24 @@ class Task:
 
     # -- init ------------------------------------------------------------
     def init(self, rng: jax.Array, batch: Batch) -> tuple[Any, Any]:
-        """Return ``(params, extra_vars)`` for an example batch."""
-        variables = self.model.init(rng, *self.model_inputs(batch), train=False)
+        """Return ``(params, extra_vars)`` for an example batch.
+
+        Scan-over-layers models (``model.scan_layers``) initialise through
+        their *unrolled* twin and restack the per-layer subtrees onto the
+        leading layer dim: every layer gets exactly the RNG stream the
+        unrolled model would give it, so ``--scan_layers`` at seed S starts
+        from bit-identical weights to the unrolled run at seed S (pinned by
+        tests/test_scan_layers.py). ``nn.scan``'s own split-rng init would
+        be statistically equivalent but not interchangeable.
+        """
+        model = self.model
+        if getattr(model, "scan_layers", False):
+            model = model.clone(scan_layers=False)
+        variables = model.init(rng, *self.model_inputs(batch), train=False)
+        if model is not self.model:
+            from ..parallel.stacking import restack_layer_trees
+
+            variables = restack_layer_trees(variables)
         params = variables.get("params", {})
         extra = {k: v for k, v in variables.items() if k != "params"}
         return params, extra
@@ -130,7 +146,11 @@ class Task:
         preds, mutated = out
         mutated = dict(mutated)
         leaves = jax.tree.leaves(mutated.pop("losses", {}))
-        aux = sum(leaves, jnp.zeros((), jnp.float32)) if leaves else None
+        # per-leaf sum: a scanned block stack sows one (num_layers,) array
+        # where the unrolled loop sows num_layers scalars — both must
+        # reduce to the same scalar aux
+        aux = (sum((jnp.sum(l) for l in leaves), jnp.zeros((), jnp.float32))
+               if leaves else None)
         return preds, {**extra_vars, **mutated}, aux
 
     def _with_aux(self, metrics: dict, aux):

@@ -26,6 +26,12 @@ from ..ops.attention import Impl, attention
 
 default_kernel_init = nn.initializers.normal(stddev=0.02)
 
+#: logical axis name of the stacked leading layer dim under
+#: ``scan_layers`` (``parallel/sharding.py`` replicates it for DDP/TP;
+#: ``fsdp_reshard`` prefers it as the split dim — one uniform,
+#: always-dividable axis across every leaf of the stack)
+SCAN_LAYER_AXIS = "layers"
+
 
 def _dense(features, dtype, name, logical_axes, kernel_init=None):
     return nn.DenseGeneral(
@@ -178,10 +184,25 @@ class EncoderBlock(nn.Module):
 
 
 class TransformerEncoder(nn.Module):
-    """Stack of encoder blocks with optional remat.
+    """Stack of encoder blocks with optional remat and scan-over-layers.
 
     ``remat`` applies ``nn.remat`` (jax.checkpoint) per block — trading
     FLOPs for HBM, the standard TPU recipe for deep/long-sequence configs.
+
+    ``scan_layers`` drives ONE compiled block body over weights stacked on
+    a leading ``(num_layers, ...)`` dim via ``nn.scan`` (the T5X/MaxText
+    ``remat_scan`` idiom): XLA traces/lowers/optimises the block once
+    instead of ``num_layers`` times, so compile time stops growing with
+    depth. Composed with ``remat``, the checkpoint sits *inside* the scan
+    body — activations saved only at layer boundaries, one block's worth
+    of recompute (the remat-scan memory profile). Parameters land under a
+    single ``layers`` subtree whose leading dim carries the
+    :data:`SCAN_LAYER_AXIS` logical name: replicated for DDP/TP
+    (``parallel/sharding.py``) and the preferred FSDP split dim. Scanned
+    and unrolled are numerically interchangeable — ``Task.init`` derives
+    scanned init by stacking the unrolled per-layer RNG streams
+    (``parallel/stacking.py``), and ``tools/convert_checkpoint.py``
+    restacks saved checkpoints either way.
     """
 
     num_layers: int
@@ -196,12 +217,42 @@ class TransformerEncoder(nn.Module):
     causal: bool = False
     remat: bool = False
     moe_experts: int = 0
+    scan_layers: bool = False
 
     @nn.compact
     def __call__(self, x, mask=None, *, train: bool = True):
         block_cls = EncoderBlock
         if self.remat:
             block_cls = nn.remat(EncoderBlock, static_argnums=(3,))
+        if self.scan_layers:
+            block = block_cls(
+                self.num_heads, self.head_dim, self.mlp_dim, self.dtype,
+                self.dropout_rate, self.pre_norm, self.attn_impl, self.mesh,
+                self.causal, moe_experts=self.moe_experts,
+                name=SCAN_LAYER_AXIS,
+            )
+
+            def body(blk, carry, _):
+                # positional train: the remat wrapper pins it static via
+                # static_argnums=(3,) (self counts as argnum 0)
+                y = blk(carry, mask, train) if self.remat else blk(
+                    carry, mask, train=train)
+                return y, None
+
+            x, _ = nn.scan(
+                body,
+                # params stack on a new leading dim; sown aux losses (MoE
+                # load-balance) stack per layer too — Task._apply_inputs
+                # sums leaves, so an (L,) stack and L scalars agree
+                variable_axes={"params": 0, "losses": 0},
+                # distinct per-layer init/dropout streams — without the
+                # split every layer would initialise identically, the
+                # classic scan-over-layers pitfall
+                split_rngs={"params": True, "dropout": True},
+                length=self.num_layers,
+                metadata_params={nn.meta.PARTITION_NAME: SCAN_LAYER_AXIS},
+            )(block, x, None)
+            return x
         for layer in range(self.num_layers):
             block = block_cls(
                 self.num_heads, self.head_dim, self.mlp_dim, self.dtype,

@@ -32,6 +32,9 @@ class VisionTransformer(nn.Module):
     dropout_rate: float = 0.0
     attn_impl: Impl = "auto"
     remat: bool = False
+    # scan-over-layers (models/transformer.py): one compiled block over
+    # (num_layers, ...)-stacked weights — O(1) compile time in depth
+    scan_layers: bool = False
 
     @nn.compact
     def __call__(self, x, *, train: bool = True):
@@ -76,6 +79,7 @@ class VisionTransformer(nn.Module):
             pre_norm=True,
             attn_impl=self.attn_impl,
             remat=self.remat,
+            scan_layers=self.scan_layers,
             name="encoder",
         )(x, train=train)
 

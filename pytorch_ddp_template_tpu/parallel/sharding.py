@@ -44,6 +44,11 @@ DEFAULT_RULES: tuple[tuple[str, str | None], ...] = (
     ("vocab", MODEL_AXIS),   # embedding vocab-split
     ("expert", EXPERT_AXIS),  # MoE expert-stack dim (models/moe.py)
     ("pipe_stage", PIPE_AXIS),  # pipeline stage-stack dim (models/gpt_pipe.py)
+    ("layers", None),        # scan-over-layers stacked layer dim
+                             # (models/transformer.py scan_layers):
+                             # replicated under DDP/TP — every rank runs
+                             # every layer; FSDP instead splits it via
+                             # fsdp_reshard(prefer_dim=0)
     ("embed", None),         # row dim of fc1/qkv: replicated (activations
                              # stay unsharded along embed between blocks)
     ("kv", None),
@@ -82,7 +87,8 @@ def shard_tree(tree: Any, mesh: Mesh,
     return jax.device_put(nn.meta.unbox(tree), shardings)
 
 
-def _shard_free_dim_over_data(tree: Any, mesh: Mesh) -> Any:
+def _shard_free_dim_over_data(tree: Any, mesh: Mesh,
+                              prefer_dim: int | None = None) -> Any:
     """Shard each leaf's *largest* dividable free dim over ``data``.
 
     Leaves already placed on the mesh (param-mirrored shardings under TP)
@@ -95,6 +101,13 @@ def _shard_free_dim_over_data(tree: Any, mesh: Mesh) -> Any:
     round-4 checkpoint layouts for the common square case. Leaves with no
     dividable dim (scalars, odd shapes) stay as they are — correctness
     never depends on a leaf being sharded.
+
+    ``prefer_dim``: when set, a leaf whose dim ``prefer_dim`` is free and
+    dividable splits THERE regardless of size — the scan-over-layers hook:
+    stacked weights all share the leading ``(num_layers, ...)`` dim, so
+    preferring it gives FSDP one uniform split axis across the whole block
+    stack (and layer-boundary all-gathers that match the scan schedule)
+    instead of a per-leaf assortment of largest dims.
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -113,11 +126,20 @@ def _shard_free_dim_over_data(tree: Any, mesh: Mesh) -> Any:
                 used.update((s,) if isinstance(s, str) else s)
         if DATA_AXIS in used:
             return x
+
+        def free_and_dividable(i):
+            return (spec[i] is None and x.shape[i] >= data_size
+                    and x.shape[i] % data_size == 0)
+
         best = None
-        for i, dim in enumerate(x.shape):
-            if spec[i] is None and dim >= data_size and dim % data_size == 0:
-                if best is None or dim > x.shape[best]:
-                    best = i
+        if (prefer_dim is not None and prefer_dim < x.ndim
+                and free_and_dividable(prefer_dim)):
+            best = prefer_dim
+        else:
+            for i, dim in enumerate(x.shape):
+                if free_and_dividable(i):
+                    if best is None or dim > x.shape[best]:
+                        best = i
         if best is not None:
             spec[best] = DATA_AXIS
             return jax.device_put(x, NamedSharding(mesh, P(*spec)))
@@ -126,7 +148,8 @@ def _shard_free_dim_over_data(tree: Any, mesh: Mesh) -> Any:
     return jax.tree.map(widen, tree)
 
 
-def zero1_reshard(opt_state: Any, mesh: Mesh) -> Any:
+def zero1_reshard(opt_state: Any, mesh: Mesh,
+                  prefer_dim: int | None = None) -> Any:
     """ZeRO-1: shard optimizer state over the ``data`` axis.
 
     The reference replicates optimizer state on every rank (``optim.SGD``
@@ -137,10 +160,11 @@ def zero1_reshard(opt_state: Any, mesh: Mesh) -> Any:
     params: ZeRO-1 semantics without a wire protocol, the same way
     sharding-induced psum replaced DDP.
     """
-    return _shard_free_dim_over_data(opt_state, mesh)
+    return _shard_free_dim_over_data(opt_state, mesh, prefer_dim)
 
 
-def fsdp_reshard(tree: Any, mesh: Mesh) -> Any:
+def fsdp_reshard(tree: Any, mesh: Mesh,
+                 prefer_dim: int | None = None) -> Any:
     """FSDP / ZeRO-3: shard params (and their optimizer mirrors) over
     ``data``.
 
@@ -152,8 +176,12 @@ def fsdp_reshard(tree: Any, mesh: Mesh) -> Any:
     layout, and the optimizer update runs shard-local. The reference has
     no analogue (SURVEY.md §2b: ZeRO/FSDP "No"); PyTorch needs a wrapper
     module and hand-scheduled gather/scatter hooks for the same semantics.
+
+    ``prefer_dim=0`` (passed by the trainer under ``--scan_layers``) makes
+    the stacked leading layer dim the split axis wherever it divides — the
+    whole block stack shards uniformly at layer granularity.
     """
-    return _shard_free_dim_over_data(tree, mesh)
+    return _shard_free_dim_over_data(tree, mesh, prefer_dim)
 
 
 def describe(mesh: Mesh) -> dict[str, Any]:

@@ -1,11 +1,26 @@
-"""Shared helper: stack per-rank pytrees on a leading mesh-axis-sharded
-dim. Used by the pipeline (one stage per ``pipe`` rank) and expert (one
-expert per ``expert`` rank) mechanisms."""
+"""Shared helpers for leading-dim weight stacking.
+
+Two families live here:
+
+- per-*rank* stacking (``stack_params``/``check_leading_axis``): pipeline
+  stages (one per ``pipe`` rank) and MoE experts (one per ``expert`` rank);
+- per-*layer* stacking for scan-over-layers
+  (``models/transformer.py scan_layers``): convert between the unrolled
+  ``layer_{i}`` param layout and the scanned single-subtree layout whose
+  leaves carry a leading ``(num_layers, ...)`` dim. These walk arbitrary
+  pytrees (params AND their optimizer-state mirrors), preserve
+  ``AxisMetadata`` boxes (the scan axis name is added/removed exactly the
+  way ``nn.scan``'s ``metadata_params`` does it), and back both
+  ``Task.init``'s scanned-equals-restacked-unrolled init and
+  ``tools/convert_checkpoint.py``.
+"""
 
 from __future__ import annotations
 
+import re
 from typing import Any
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -32,3 +47,137 @@ def check_leading_axis(params: Any, n: int, axis_desc: str) -> None:
             f"params leading axis {sorted(leading)} != {axis_desc} size "
             f"{n}; stack exactly one item per rank"
         )
+
+
+# -- scan-over-layers layout conversion ---------------------------------
+
+_LAYER_KEY = re.compile(r"^layer_(\d+)$")
+
+#: default name of both the stacked subtree key and the logical axis of
+#: its leading dim (matches models/transformer.py SCAN_LAYER_AXIS)
+LAYER_AXIS = "layers"
+
+
+def _is_box(x: Any) -> bool:
+    return isinstance(x, nn.meta.AxisMetadata)
+
+
+def _rebuild(tree: list | tuple, children: list) -> Any:
+    """Reconstruct a sequence node with converted children — NamedTuples
+    (live optax states like ``ScaleByAdamState``) need splat construction,
+    plain lists/tuples take an iterable."""
+    if isinstance(tree, tuple) and hasattr(tree, "_fields"):
+        return type(tree)(*children)
+    return type(tree)(children)
+
+
+def stack_layer_tree(per_layer: list[Any], axis_name: str = LAYER_AXIS) -> Any:
+    """Stack per-layer pytrees on a new leading dim. Boxed leaves
+    (``nn.Partitioned``/``LogicallyPartitioned``) gain ``axis_name`` at
+    position 0 through the box's own ``add_axis`` — byte-identical to what
+    ``nn.scan(metadata_params={PARTITION_NAME: axis_name})`` produces."""
+
+    def _stack(*xs):
+        if _is_box(xs[0]):
+            stacked = xs[0].replace_boxed(jnp.stack([b.unbox() for b in xs]))
+            return stacked.add_axis(0, {nn.meta.PARTITION_NAME: axis_name})
+        return jnp.stack(xs)
+
+    return jax.tree.map(_stack, *per_layer, is_leaf=_is_box)
+
+
+def unstack_layer_tree(stacked: Any, axis_name: str = LAYER_AXIS) -> list[Any]:
+    """Split a stacked layer tree back into per-layer pytrees (inverse of
+    :func:`stack_layer_tree`); the leading-dim size must agree on every
+    leaf (a ragged stack means the tree was never layer-stacked)."""
+    leaves = jax.tree.leaves(stacked, is_leaf=_is_box)
+    sizes = {(leaf.unbox() if _is_box(leaf) else leaf).shape[0]
+             for leaf in leaves}
+    if len(sizes) != 1:
+        raise ValueError(
+            f"stacked layer tree has inconsistent leading dims {sorted(sizes)}"
+        )
+    (num_layers,) = sizes
+
+    def _slice(i):
+        def take(x):
+            if _is_box(x):
+                sliced = x.remove_axis(0, {nn.meta.PARTITION_NAME: axis_name})
+                return sliced.replace_boxed(x.unbox()[i])
+            return x[i]
+        return jax.tree.map(take, stacked, is_leaf=_is_box)
+
+    return [_slice(i) for i in range(num_layers)]
+
+
+def _layer_dict_size(tree: Any) -> int | None:
+    """``num_layers`` when ``tree`` is a dict of exactly ``layer_0 ..
+    layer_{L-1}``, else None."""
+    if not isinstance(tree, dict) or not tree:
+        return None
+    idx = []
+    for k in tree:
+        m = _LAYER_KEY.match(str(k))
+        if m is None:
+            return None
+        idx.append(int(m.group(1)))
+    return len(idx) if sorted(idx) == list(range(len(idx))) else None
+
+
+def restack_layer_trees(tree: Any, axis_name: str = LAYER_AXIS) -> Any:
+    """Unrolled → scanned: every ``{layer_0 .. layer_{L-1}}`` dict in the
+    tree becomes ``{axis_name: stacked}``. Works on params and on
+    optimizer-state mirrors (any pytree whose dicts use the layer keys)."""
+    if _layer_dict_size(tree) is not None:
+        n = _layer_dict_size(tree)
+        per = [restack_layer_trees(tree[f"layer_{i}"], axis_name)
+               for i in range(n)]
+        return {axis_name: stack_layer_tree(per, axis_name)}
+    if isinstance(tree, dict):
+        return {k: restack_layer_trees(v, axis_name) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return _rebuild(tree, [restack_layer_trees(v, axis_name)
+                               for v in tree])
+    return tree
+
+
+def unroll_layer_trees(tree: Any, axis_name: str = LAYER_AXIS) -> Any:
+    """Scanned → unrolled: every ``{axis_name: stacked}`` dict becomes
+    ``{layer_0 .. layer_{L-1}}`` (inverse of :func:`restack_layer_trees`)."""
+    if isinstance(tree, dict):
+        if set(tree) == {axis_name}:
+            per = unstack_layer_tree(tree[axis_name], axis_name)
+            return {f"layer_{i}": unroll_layer_trees(p, axis_name)
+                    for i, p in enumerate(per)}
+        return {k: unroll_layer_trees(v, axis_name) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return _rebuild(tree, [unroll_layer_trees(v, axis_name)
+                               for v in tree])
+    return tree
+
+
+def detect_layer_layout(tree: Any, axis_name: str = LAYER_AXIS) -> str:
+    """``"scanned"``, ``"unrolled"``, or ``"none"`` — which layer layout a
+    (params or whole-state) pytree carries. Drives the fail-with-intent
+    checks in ``train/engine.py`` and ``tools/convert_checkpoint.py``."""
+    found = {"none"}
+
+    def walk(t):
+        if isinstance(t, dict):
+            if set(t) == {axis_name}:
+                found.add("scanned")
+            if _layer_dict_size(t) is not None:
+                found.add("unrolled")
+            for v in t.values():
+                walk(v)
+        elif isinstance(t, (list, tuple)):
+            for v in t:
+                walk(v)
+
+    walk(tree)
+    if "scanned" in found and "unrolled" in found:
+        raise ValueError("tree mixes scanned and unrolled layer layouts")
+    for kind in ("scanned", "unrolled"):
+        if kind in found:
+            return kind
+    return "none"

@@ -332,21 +332,31 @@ class Trainer:
         )
 
         state = shard_tree(state, self.ctx.mesh)
+        # scan-over-layers stacks every block weight on a leading
+        # (num_layers, ...) dim — prefer splitting THERE so the whole
+        # stack shards uniformly at layer granularity (one dividable axis
+        # for FSDP instead of a per-leaf assortment of largest dims)
+        prefer = 0 if self.config.scan_layers else None
         if self.config.fsdp:
             # full ZeRO-3 split: weights, grads (via GSPMD propagation)
             # and optimizer mirrors all live sharded over ``data``
             state = state.replace(
-                params=fsdp_reshard(state.params, self.ctx.mesh),
-                opt_state=fsdp_reshard(state.opt_state, self.ctx.mesh),
+                params=fsdp_reshard(state.params, self.ctx.mesh,
+                                    prefer_dim=prefer),
+                opt_state=fsdp_reshard(state.opt_state, self.ctx.mesh,
+                                       prefer_dim=prefer),
             )
         elif self.config.zero1:
             state = state.replace(
-                opt_state=zero1_reshard(state.opt_state, self.ctx.mesh)
+                opt_state=zero1_reshard(state.opt_state, self.ctx.mesh,
+                                        prefer_dim=prefer)
             )
         return state
 
     def restore_or_init(self) -> tuple[TrainState, int]:
-        state = self.init_state()
+        # config compatibility is validated BEFORE the (expensive) template
+        # init: a doomed restore should fail in milliseconds with its
+        # intent message, not after a full model init + placement
         want = self.config.global_step if self.config.global_step > 0 else None
         if want is not None and self.ckpt.latest_step() is None:
             # an explicit --global_step that cannot be honoured must not
@@ -367,6 +377,28 @@ class Trainer:
                     f"{self.config.optimizer}; pass --no_resume or a fresh "
                     "--output_dir to start over"
                 )
+            # checkpoints from before the scan_layers flag existed lack
+            # the key and are necessarily unrolled — default False so they
+            # still get the actionable error under --scan_layers
+            saved_scan = saved.get("scan_layers", False)
+            if bool(saved_scan) != bool(self.config.scan_layers):
+                # same failure discipline for the layer layout: an
+                # unrolled layer_{i} tree cannot restore into a scanned
+                # (num_layers, ...)-stacked template or vice versa — and
+                # unlike the optimizer case, a converter exists
+                have, want_l = (("unrolled", "scanned")
+                                if self.config.scan_layers
+                                else ("scanned", "unrolled"))
+                raise ValueError(
+                    f"checkpoint at step {want or self.ckpt.latest_step()} "
+                    f"holds the {have} layer layout but this run "
+                    f"{'sets' if self.config.scan_layers else 'omits'} "
+                    f"--scan_layers ({want_l} layout); convert it with "
+                    f"`python tools/convert_checkpoint.py --src "
+                    f"{self.ckpt.directory} --dst <new_dir> --to {want_l}` "
+                    "or pass --no_resume / a fresh --output_dir"
+                )
+            state = self.init_state()
             try:
                 state, _ = self.ckpt.restore(want, state)
             except Exception as exc:
@@ -382,7 +414,7 @@ class Trainer:
                     "--output_dir to start over"
                 ) from exc
             return state, int(state.step)
-        return state, 0
+        return self.init_state(), 0
 
     # -- loops ------------------------------------------------------------
     def evaluate(self, state: TrainState) -> dict[str, float]:

@@ -9,6 +9,8 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
 REPO = Path(__file__).resolve().parent.parent
 
 
@@ -58,6 +60,36 @@ def test_scaling_mode_flags_degenerate_single_device():
     row = lines[-1]
     assert row["degenerate"] is True
     assert row["vs_baseline"] == 0.0  # a 1-chip sweep must not read as a pass
+
+
+@pytest.mark.slow
+def test_compile_mode_contract():
+    """BENCH_MODE=compile: one JSON line carrying the per-depth unrolled vs
+    scanned compile table and the throughput-neutrality step-time leg
+    (slow: a subprocess compiling four tiny models — the committed record
+    in bench_records/compile_scan_cpu_r7.jsonl is the tier-1-visible
+    evidence; tests/test_scan_layers.py's trace-time guard is the fast
+    re-unrolling tripwire)."""
+    # depths deliberately unsorted and warmup 0: the headline must come
+    # from the DEEPEST row, and the step-time leg must not need a warmup
+    # metric to fence on
+    code, lines, out = run_bench({
+        "BENCH_MODE": "compile", "BENCH_DEPTHS": "2,1", "BENCH_BATCH": "2",
+        "BENCH_SEQ": "16", "BENCH_WARMUP": "0", "BENCH_STEPS": "2",
+    })
+    assert code == 0, out[-2000:]
+    assert len(lines) == 1, out[-2000:]
+    row = lines[0]
+    assert REQUIRED <= set(row)
+    assert row["metric"] == "scan_compile_speedup_2L"
+    assert row["value"] > 0
+    depth2 = next(r for r in row["compile_table"] if r["depth"] == 2)
+    assert row["value"] == depth2["compile_speedup"]
+    assert [r["depth"] for r in row["compile_table"]] == [2, 1]
+    for r in row["compile_table"]:
+        assert r["unrolled_total_s"] > 0 and r["scanned_total_s"] > 0
+    assert row["step_time_unrolled_ms"] > 0
+    assert row["step_time_scanned_ms"] > 0
 
 
 def test_unknown_mode_fails_as_json():

@@ -12,16 +12,27 @@ each worker runs.
 
 import json
 import os
+import re
 import socket
 import subprocess
 import sys
 from pathlib import Path
 
 import numpy as np
+import pytest
 
 WORKER = Path(__file__).resolve().parent / "two_process_worker.py"
 PREEMPT_WORKER = Path(__file__).resolve().parent / "two_process_preempt_worker.py"
 REPO = WORKER.parent.parent
+
+# jaxlib builds without cross-process CPU collectives kill the worker at
+# jax.distributed init with this wording — an environment limitation, not
+# a regression: skip (with the backend named) so tier-1 output tells the
+# two apart instead of reporting a fail
+_BACKEND_LIMIT = re.compile(
+    r"[Mm]ultiprocess computations aren'?t implemented on the "
+    r"(\w+) backend"
+)
 
 
 def _free_port() -> int:
@@ -54,6 +65,15 @@ def _run_pair(worker: Path, tmp_path, timeout: int = 300) -> list[str]:
             raise
         outs.append(out)
     for p, out in zip(procs, outs):
+        if p.returncode != 0:
+            m = _BACKEND_LIMIT.search(out)
+            if m is not None:
+                pytest.skip(
+                    "this jaxlib has no multiprocess computations on the "
+                    f"{m.group(1)} backend (jax.distributed init refused) — "
+                    "environmental, not a regression; the two-process "
+                    "rehearsal needs a backend with cross-process collectives"
+                )
         assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
     return outs
 

@@ -1,0 +1,457 @@
+"""Scan-over-layers (``--scan_layers``, models/transformer.py): the scanned
+single-block stack must be numerically interchangeable with the unrolled
+loop — identical init (Task.init stacks the unrolled per-layer RNG
+streams), identical forward/grads/eval metrics on a fixed batch, lossless
+checkpoint layout conversion (tools/convert_checkpoint.py) — while trace
+time stops growing with depth (the whole point: O(1) compile time)."""
+
+import collections
+import importlib.util
+import time
+from pathlib import Path
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_ddp_template_tpu.config import TrainingConfig
+from pytorch_ddp_template_tpu.models import build
+from pytorch_ddp_template_tpu.parallel.stacking import (
+    detect_layer_layout,
+    restack_layer_trees,
+    unroll_layer_trees,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+TINY = ["gpt-tiny", "bert-tiny", "vit-tiny"]
+
+
+def _convert_tool():
+    spec = importlib.util.spec_from_file_location(
+        "convert_checkpoint", REPO / "tools" / "convert_checkpoint.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _pair(name, batch_size=4, **over):
+    """(unrolled task, scanned task, batch) for one registry entry."""
+    cfg_u = TrainingConfig(model=name, dataset_size=32, **over)
+    cfg_s = TrainingConfig(model=name, dataset_size=32, scan_layers=True,
+                           **over)
+    task_u, ds = build(name, cfg_u)
+    task_s, _ = build(name, cfg_s)
+    batch = {k: jnp.asarray(v)
+             for k, v in ds.batch(np.arange(batch_size)).items()}
+    return task_u, task_s, batch
+
+
+def _count(params):
+    return sum(int(np.prod(l.shape))
+               for l in jax.tree.leaves(nn.meta.unbox(params)))
+
+
+def _max_abs_diff(a, b):
+    return max(
+        float(jnp.max(jnp.abs(x.astype(jnp.float32) - y.astype(jnp.float32))))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+# -- init interchangeability ---------------------------------------------
+
+def _assert_init_interchangeable(params_u, params_s):
+    """--scan_layers at seed S starts from the SAME weights as the
+    unrolled run at seed S: Task.init derives scanned init by stacking the
+    unrolled per-layer RNG streams. Pins layout detection, param count
+    (stacking must not invent or drop a single scalar), bit-equality, and
+    per-layer-distinct streams (the classic scan pitfall would make every
+    layer identical)."""
+    assert detect_layer_layout(nn.meta.unbox(params_u)) == "unrolled"
+    assert detect_layer_layout(nn.meta.unbox(params_s)) == "scanned"
+    assert _count(params_u) == _count(params_s)
+    restacked = restack_layer_trees(params_u)
+    assert (jax.tree.structure(nn.meta.unbox(restacked))
+            == jax.tree.structure(nn.meta.unbox(params_s)))
+    assert _max_abs_diff(nn.meta.unbox(restacked),
+                         nn.meta.unbox(params_s)) == 0.0
+    unstacked = unroll_layer_trees(nn.meta.unbox(params_s))
+
+    def layers_of(tree):
+        found = []
+
+        def walk(t):
+            if isinstance(t, dict):
+                if "layer_0" in t:
+                    found.append(t)
+                for v in t.values():
+                    walk(v)
+
+        walk(tree)
+        return found
+
+    (layer_dict,) = layers_of(unstacked)
+    assert _max_abs_diff(layer_dict["layer_0"], layer_dict["layer_1"]) > 0.0
+
+
+def _assert_native_init_structure_matches(task_s, batch, params_s):
+    """The scanned module's own flax init (nn.scan split-rng streams — the
+    path Task.init replaces) must still agree on structure/shapes, so any
+    restacked tree is a drop-in for scan apply."""
+    native = jax.eval_shape(
+        lambda: task_s.model.init(jax.random.PRNGKey(0),
+                                  *task_s.model_inputs(batch), train=False)
+    )["params"]
+    unboxed_native = nn.meta.unbox(native)
+    unboxed = nn.meta.unbox(params_s)
+    assert (jax.tree.structure(unboxed_native)
+            == jax.tree.structure(unboxed))
+    for a, b in zip(jax.tree.leaves(unboxed_native), jax.tree.leaves(unboxed)):
+        assert a.shape == b.shape
+
+
+# -- forward / grad / metrics parity -------------------------------------
+
+# tier-1 runs the full no-remat sweep plus the gpt remat-scan pair; the
+# bert/vit remat variants ride in the full (slow-inclusive) run — same
+# code path, and the 870s tier-1 budget is the binding constraint
+PARITY_CASES = [(name, False) for name in TINY] + [
+    ("gpt-tiny", True),
+    pytest.param("bert-tiny", True, marks=pytest.mark.slow),
+    pytest.param("vit-tiny", True, marks=pytest.mark.slow),
+]
+
+
+@pytest.mark.parametrize("name,remat", PARITY_CASES)
+def test_loss_grad_and_eval_metric_parity(name, remat):
+    over = {"remat": True} if remat else {}
+    task_u, task_s, batch = _pair(name, **over)
+    key = jax.random.PRNGKey(0)
+    params_u, extra_u = task_u.init(key, batch)
+    params_s, extra_s = task_s.init(key, batch)
+    if not remat:  # init interchangeability, pinned per family
+        _assert_init_interchangeable(params_u, params_s)
+        if name == "gpt-tiny":
+            _assert_native_init_structure_matches(task_s, batch, params_s)
+    pu, ps = nn.meta.unbox(params_u), nn.meta.unbox(params_s)
+
+    # one traced computation per layout: eval-mode loss + metrics
+    # (dropout off, masking deterministic) and grads together
+    def val_and_grad(task, p, extra):
+        def f(p):
+            loss, _, metrics = task.loss(p, extra, batch, None, train=False)
+            return loss, metrics
+
+        (loss, metrics), grads = jax.value_and_grad(f, has_aux=True)(p)
+        return loss, metrics, grads
+
+    lu, mu, gu = val_and_grad(task_u, pu, extra_u)
+    ls, ms, gs = val_and_grad(task_s, ps, extra_s)
+
+    # the scanned stack must produce the identical eval curve
+    np.testing.assert_allclose(np.asarray(lu), np.asarray(ls), atol=1e-5)
+    assert set(mu) == set(ms)
+    for k in mu:
+        np.testing.assert_allclose(np.asarray(mu[k]), np.asarray(ms[k]),
+                                   atol=1e-5, err_msg=k)
+    # grads through the respective layouts agree layer-for-layer
+    assert _max_abs_diff(restack_layer_trees(gu), gs) < 2e-4
+
+
+def test_moe_train_loss_and_aux_parity():
+    """moe_experts>0 inside the scan body: the sown load-balance terms
+    stack per layer instead of arriving as separate scalars — total and
+    aux must agree with the unrolled stack exactly (same init streams)."""
+    task_u, task_s, batch = _pair("gpt-moe-tiny")
+    key = jax.random.PRNGKey(0)
+    params_u, extra_u = task_u.init(key, batch)
+    params_s, extra_s = task_s.init(key, batch)
+    lu, _, mu = task_u.loss(nn.meta.unbox(params_u), extra_u, batch,
+                            jax.random.PRNGKey(1), train=True)
+    ls, _, ms = task_s.loss(nn.meta.unbox(params_s), extra_s, batch,
+                            jax.random.PRNGKey(1), train=True)
+    np.testing.assert_allclose(np.asarray(lu), np.asarray(ls), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(mu["aux_loss"]),
+                               np.asarray(ms["aux_loss"]), atol=1e-5)
+    assert np.asarray(ms["aux_loss"]).shape == ()  # stacked sow reduced
+
+
+@pytest.mark.slow
+def test_train_step_parity_through_engine():
+    """One jitted optimizer step (gpt-tiny, dropout-free): scanned and
+    unrolled runs starting from the same seed produce the same loss and
+    the same updated weights — the whole-engine interchangeability."""
+    from pytorch_ddp_template_tpu.train.engine import (
+        TrainState, make_optimizer, make_train_step,
+    )
+
+    cfg = TrainingConfig(model="gpt-tiny", dataset_size=32, warmup_steps=0)
+    task_u, task_s, batch = _pair("gpt-tiny")
+    key = jax.random.PRNGKey(0)
+    tx, schedule = make_optimizer(cfg, total_steps=10)
+    states, metrics = {}, {}
+    for tag, task in (("unrolled", task_u), ("scanned", task_s)):
+        params, extra = task.init(key, batch)
+        params = nn.meta.unbox(params)
+        state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                           extra_vars=extra, opt_state=tx.init(params),
+                           rng=jax.random.clone(key))
+        step = make_train_step(task, tx, schedule)
+        state, m = step(state, batch)
+        states[tag], metrics[tag] = state, m
+    np.testing.assert_allclose(np.asarray(metrics["unrolled"]["loss"]),
+                               np.asarray(metrics["scanned"]["loss"]),
+                               atol=1e-5)
+    assert _max_abs_diff(restack_layer_trees(states["unrolled"].params),
+                         states["scanned"].params) < 2e-4
+
+
+# -- checkpoint layout conversion ----------------------------------------
+
+def _tiny_trainer(tmp_path, subdir, scan_layers):
+    from pytorch_ddp_template_tpu.runtime import make_mesh
+    from pytorch_ddp_template_tpu.runtime.context import RuntimeContext
+    from pytorch_ddp_template_tpu.train.engine import Trainer
+
+    cfg = TrainingConfig(
+        model="gpt-tiny", dataset_size=32, per_device_train_batch_size=1,
+        max_steps=2, save_steps=2, logging_steps=0, warmup_steps=0,
+        optimizer="momentum", scan_layers=scan_layers,
+        output_dir=str(tmp_path / subdir),
+    )
+    mesh = make_mesh("data:-1", jax.devices())
+    key = jax.random.PRNGKey(0)
+    ctx = RuntimeContext(mesh=mesh, seed_key=key,
+                         host_key=jax.random.fold_in(key, 0), config=cfg)
+    task, ds = build(cfg.model, cfg)
+    return Trainer(cfg, ctx, task, ds), cfg
+
+
+def test_convert_state_tree_roundtrip():
+    """Fast tier-1 twin of the orbax integration test below: the whole
+    TrainState-shaped tree (params + optimizer mirrors + scalars) converts
+    unrolled→scanned→unrolled bit-exact, and the layout walk catches the
+    refusal cases — no model build, no filesystem."""
+    from pytorch_ddp_template_tpu.parallel.stacking import stack_layer_tree
+
+    tool = _convert_tool()
+    rng = np.random.default_rng(0)
+    normal = lambda *s: rng.standard_normal(s).astype(np.float32)
+    layer = lambda: {"attention": {"kernel": normal(4, 4)},
+                     "mlp": {"bias": normal(3)}}
+    layers = {f"layer_{i}": layer() for i in range(3)}
+    # optimizer mirror carries the same per-layer subtrees params do; a
+    # NamedTuple node models a LIVE optax state (ScaleByAdamState et al.),
+    # which needs splat reconstruction, not an iterable
+    TraceState = collections.namedtuple("TraceState", ["trace"])
+    state = {
+        "step": np.asarray(7),
+        "params": {"decoder": dict(layers), "wte": normal(8, 4)},
+        "opt_state": [TraceState(trace={"decoder": {
+            f"layer_{i}": layer() for i in range(3)}})],
+    }
+    scanned = tool.convert_state(state, "scanned")
+    assert detect_layer_layout(scanned) == "scanned"
+    assert isinstance(scanned["opt_state"][0], TraceState)
+    stacked = scanned["params"]["decoder"]["layers"]
+    assert stacked["attention"]["kernel"].shape == (3, 4, 4)
+    back = tool.convert_state(scanned, "unrolled")
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(back)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    with pytest.raises(ValueError, match="already in the scanned layout"):
+        tool.convert_state(scanned, "scanned")
+    with pytest.raises(ValueError, match="inconsistent leading dims"):
+        tool.convert_state(
+            {"layers": {"a": np.zeros((2, 3)), "b": np.zeros((4, 3))}},
+            "unrolled")
+    # stack_layer_tree and nn.scan agree on the boxed-axis bookkeeping
+    boxed = [{"w": nn.Partitioned(jnp.ones((2, 2)), names=("mlp", None))}
+             for _ in range(2)]
+    out = stack_layer_tree(boxed)
+    assert out["w"].names == ("layers", "mlp", None)
+
+
+def test_mismatched_layout_restore_fails_with_intent(tmp_path):
+    """The engine refuses a layout-mismatched restore from the saved
+    config alone — before building any template state — naming the
+    converter command. A trivial payload suffices: the check never reads
+    the state."""
+    from pytorch_ddp_template_tpu.checkpoint.manager import CheckpointManager
+
+    cfg = TrainingConfig(model="gpt-tiny", dataset_size=32,
+                         per_device_train_batch_size=1, scan_layers=False,
+                         optimizer="momentum",  # match _tiny_trainer: the
+                         #                        optimizer check fires first
+                         output_dir=str(tmp_path / "unrolled"))
+    mngr = CheckpointManager(cfg.output_dir)
+    mngr.save(3, {"step": np.zeros((), np.int32)}, cfg, force=True)
+    mngr.wait()
+    mngr.close()
+    trainer, _ = _tiny_trainer(tmp_path, "unrolled", scan_layers=True)
+    with pytest.raises(ValueError, match="convert_checkpoint"):
+        trainer.restore_or_init()
+    trainer.ckpt.close()
+
+
+@pytest.mark.slow
+def test_checkpoint_conversion_roundtrip_and_mismatch(tmp_path):
+    """save unrolled → convert → restore under --scan_layers (and the
+    reverse), plus the fail-with-intent mismatched-layout restore. The
+    checkpoint is written through the production CheckpointManager;
+    ``optimizer=momentum`` gives the opt_state param-shaped mirrors, so
+    the converter's walk over non-param subtrees is exercised too.
+    (slow: orbax manager + Trainer template setup; the fast tree-level
+    twin above plus the engine's config check stay tier-1.)"""
+    from pytorch_ddp_template_tpu.checkpoint.manager import CheckpointManager
+    from pytorch_ddp_template_tpu.train.engine import (
+        TrainState, make_optimizer,
+    )
+
+    tool = _convert_tool()
+    cfg = TrainingConfig(
+        model="gpt-tiny", dataset_size=32, per_device_train_batch_size=1,
+        optimizer="momentum", warmup_steps=0,
+        output_dir=str(tmp_path / "unrolled"),
+    )
+    task_u, _, batch = _pair("gpt-tiny", optimizer="momentum")
+    params, extra = task_u.init(jax.random.PRNGKey(0), batch)
+    params = nn.meta.unbox(params)
+    tx, _ = make_optimizer(cfg, total_steps=10)
+    state = TrainState(step=jnp.asarray(2, jnp.int32), params=params,
+                       extra_vars=extra, opt_state=tx.init(params),
+                       rng=jax.random.PRNGKey(1))
+    mngr = CheckpointManager(str(tmp_path / "unrolled"))
+    mngr.save(2, state, cfg, force=True)
+    mngr.wait()
+    mngr.close()
+    saved_params = jax.device_get(params)
+
+    # restoring the unrolled checkpoint under --scan_layers without
+    # conversion must fail with intent, naming the converter
+    mis_trainer, _ = _tiny_trainer(tmp_path, "unrolled", scan_layers=True)
+    with pytest.raises(ValueError, match="convert_checkpoint"):
+        mis_trainer.restore_or_init()
+    mis_trainer.ckpt.close()
+
+    # convert -> a --scan_layers run restores the restacked weights (and
+    # momentum mirrors) through the full Trainer template path
+    step = tool.convert_checkpoint(str(tmp_path / "unrolled"),
+                                   str(tmp_path / "scanned"), "scanned")
+    assert step == 2
+    scan_trainer, _ = _tiny_trainer(tmp_path, "scanned", scan_layers=True)
+    scan_state, start = scan_trainer.restore_or_init()
+    scan_trainer.ckpt.close()
+    assert start == 2
+    assert _max_abs_diff(restack_layer_trees(saved_params),
+                         jax.device_get(scan_state.params)) == 0.0
+
+    # reverse conversion round-trips the whole state bit-exact
+    tool.convert_checkpoint(str(tmp_path / "scanned"),
+                            str(tmp_path / "back"), "unrolled")
+    back = CheckpointManager(str(tmp_path / "back"))
+    step_b, state_b, cfg_b = back.restore_raw()
+    back.close()
+    assert step_b == 2 and cfg_b["scan_layers"] is False
+    assert _max_abs_diff(saved_params, state_b["params"]) == 0.0
+    orig_opt = jax.device_get(jax.tree.leaves(state.opt_state))
+    back_opt = jax.tree.leaves(state_b["opt_state"])
+    assert len(orig_opt) == len(back_opt)
+    for a, b in zip(orig_opt, back_opt):
+        assert np.asarray(a).shape == np.asarray(b).shape
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    # converting a checkpoint already in the target layout is refused
+    with pytest.raises(ValueError, match="already in the scanned layout"):
+        tool.convert_checkpoint(str(tmp_path / "scanned"),
+                                str(tmp_path / "noop"), "scanned")
+
+
+def test_convert_state_refuses_layerless_tree():
+    tool = _convert_tool()
+    with pytest.raises(ValueError, match="no transformer layer stack"):
+        tool.convert_state({"params": {"dense": {"kernel": np.zeros((2, 2))}}},
+                           "scanned")
+
+
+# -- config surface -------------------------------------------------------
+
+def test_scan_layers_rejected_where_it_cannot_apply():
+    with pytest.raises(ValueError, match="no transformer layer stack"):
+        build("mlp", TrainingConfig(model="mlp", scan_layers=True))
+    with pytest.raises(ValueError, match="GPipe pipeline"):
+        build("gpt-pipe-tiny",
+              TrainingConfig(model="gpt-pipe-tiny", scan_layers=True))
+
+
+def test_fsdp_prefers_leading_layer_dim():
+    """Under --scan_layers the FSDP split lands on the stacked layer dim
+    (uniform, always-dividable) instead of each leaf's largest dim."""
+    from pytorch_ddp_template_tpu.parallel.sharding import fsdp_reshard
+    from pytorch_ddp_template_tpu.runtime import make_mesh
+
+    mesh = make_mesh("data:-1", jax.devices())
+    n = mesh.shape["data"]
+    leaf = jnp.zeros((n, 4 * n))  # largest dim is 1, leading dim is 0
+    def spec2(x):  # normalise trailing Nones: P("data") == P("data", None)
+        s = tuple(x.sharding.spec)
+        return s + (None,) * (2 - len(s))
+
+    default = fsdp_reshard({"w": leaf}, mesh)
+    preferred = fsdp_reshard({"w": leaf}, mesh, prefer_dim=0)
+    assert spec2(default["w"]) == (None, "data")
+    assert spec2(preferred["w"]) == ("data", None)
+    # a leaf whose preferred dim does not divide falls back to largest
+    odd = jnp.zeros((n + 1, 4 * n))
+    fallback = fsdp_reshard({"w": odd}, mesh, prefer_dim=0)
+    assert spec2(fallback["w"]) == (None, "data")
+
+
+# -- compile-time regression guard ---------------------------------------
+
+@pytest.mark.parametrize("depths", [(2, 8)])
+def test_trace_time_stays_flat_in_depth(depths):
+    """Tracing the scanned train step at depth 8 must cost about what
+    depth 2 costs — a re-unrolling regression (scan silently falling back
+    to a Python loop) would show ~4x. Wall-time-loose (3x bound, floored
+    denominator) so the noisy 2-core host cannot flake it."""
+    from pytorch_ddp_template_tpu.models.gpt import CausalLmTask, GptDecoder
+    from pytorch_ddp_template_tpu.train.engine import (
+        TrainState, make_optimizer, make_train_step,
+    )
+
+    cfg = TrainingConfig(warmup_steps=0)
+    batch = {"input_ids": jnp.asarray(
+        np.random.default_rng(0).integers(0, 128, (2, 16)), jnp.int32)}
+    tx, schedule = make_optimizer(cfg, total_steps=10)
+
+    def trace_seconds(depth):
+        model = GptDecoder(vocab_size=128, max_len=16, num_layers=depth,
+                           num_heads=2, head_dim=8, mlp_dim=32,
+                           scan_layers=True)
+        task = CausalLmTask(model)
+        # shape-only init (eval_shape) + zeros: the guard times TRACING,
+        # so real weights would only add eager init cost to the test
+        shapes = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0), batch["input_ids"],
+                               train=False))["params"]
+        params = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                              nn.meta.unbox(shapes))
+        state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                           extra_vars={}, opt_state=tx.init(params),
+                           rng=jax.random.PRNGKey(1))
+        step = make_train_step(task, tx, schedule)
+        t0 = time.perf_counter()
+        step.lower(state, batch)
+        return time.perf_counter() - t0
+
+    shallow, deep = depths
+    t_shallow = min(trace_seconds(shallow) for _ in range(2))
+    t_deep = min(trace_seconds(deep) for _ in range(2))
+    assert t_deep <= 3.0 * max(t_shallow, 0.05), (
+        f"trace time grew {t_deep / max(t_shallow, 1e-9):.1f}x from depth "
+        f"{shallow} to {deep} — did the scan re-unroll?"
+    )

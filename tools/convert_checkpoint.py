@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Restack a checkpoint between the unrolled and scanned layer layouts.
+
+``--scan_layers`` (models/transformer.py) stores every transformer block
+weight stacked on a leading ``(num_layers, ...)`` dim under one ``layers``
+subtree; the unrolled layout keeps ``num_layers`` separate ``layer_{i}``
+subtrees. A checkpoint written in one layout cannot restore into the other
+— ``train/engine.py`` refuses the mismatch with a pointer here. This tool
+converts a whole step directory (params AND their optimizer-state mirrors,
+which carry the same per-layer subtrees) and writes a new checkpoint tree
+the other mode restores from directly:
+
+    # unrolled run -> continue under --scan_layers
+    python tools/convert_checkpoint.py --src outputs --dst outputs_scan \
+        --to scanned
+    python ddp.py --model gpt-small --scan_layers --output_dir outputs_scan
+
+    # scanned run -> back to the unrolled layout
+    python tools/convert_checkpoint.py --src outputs_scan --dst outputs \
+        --to unrolled
+
+Conversion is lossless and involutive (tests/test_scan_layers.py pins the
+round-trip bit-exact). The RNG-stream provenance note: the converted
+checkpoint records the *current* host's native-RNG availability, so an
+exact mid-epoch data-order replay additionally needs the same RNG stream
+as the original run (checkpoint/manager.py warns on restore if not).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def convert_state(state, to: str):
+    """Return ``state`` restacked into layout ``to`` ("scanned"/"unrolled"),
+    failing with intent when the tree is already there (or has no layer
+    stack at all — e.g. an MLP/ResNet checkpoint)."""
+    from pytorch_ddp_template_tpu.parallel.stacking import (
+        detect_layer_layout, restack_layer_trees, unroll_layer_trees,
+    )
+
+    have = detect_layer_layout(state)
+    if have == "none":
+        raise ValueError(
+            "checkpoint holds no transformer layer stack (neither layer_{i} "
+            "subtrees nor a stacked 'layers' subtree) — nothing to convert; "
+            "--scan_layers applies to the transformer families only"
+        )
+    if have == to:
+        raise ValueError(
+            f"checkpoint is already in the {to} layout; converting would be "
+            "a no-op — point --src at the other layout or skip the step"
+        )
+    return (restack_layer_trees(state) if to == "scanned"
+            else unroll_layer_trees(state))
+
+
+def convert_checkpoint(src: str, dst: str, to: str,
+                       step: int | None = None) -> int:
+    """Convert one step of ``src`` into a fresh checkpoint tree at ``dst``;
+    returns the converted step number."""
+    import json
+
+    from pytorch_ddp_template_tpu.checkpoint.manager import CheckpointManager
+    from pytorch_ddp_template_tpu.config import TrainingConfig
+
+    if Path(dst).resolve() == Path(src).resolve():
+        raise ValueError(
+            "--dst must differ from --src: orbax owns the step layout under "
+            "a managed directory, and converting in place would race the "
+            "source it reads from"
+        )
+    src_mngr = CheckpointManager(src)
+    try:
+        step, state, cfg = src_mngr.restore_raw(step)
+    finally:
+        src_mngr.close()
+    converted = convert_state(state, to)
+    cfg = dict(cfg or {})
+    cfg["scan_layers"] = to == "scanned"
+    # provenance keys (_native_rng, _train_batch_size) are recomputed by
+    # save() from the reconstructed config — no manual carry-over needed
+    config = TrainingConfig.from_json(json.dumps(cfg))
+    dst_mngr = CheckpointManager(dst)
+    try:
+        dst_mngr.save(step, converted, config, force=True)
+        dst_mngr.wait()
+    finally:
+        dst_mngr.close()
+    return step
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--src", required=True,
+                   help="checkpoint directory to read (an --output_dir)")
+    p.add_argument("--dst", required=True,
+                   help="directory for the converted checkpoint (must "
+                        "differ from --src)")
+    p.add_argument("--to", required=True, choices=["scanned", "unrolled"],
+                   help="destination layer layout")
+    p.add_argument("--step", type=int, default=None,
+                   help="checkpoint step to convert (default: latest)")
+    args = p.parse_args(argv)
+    step = convert_checkpoint(args.src, args.dst, args.to, args.step)
+    print(f"converted step {step}: {args.src} -> {args.dst} ({args.to})")
+
+
+if __name__ == "__main__":
+    main()
