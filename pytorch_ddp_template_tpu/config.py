@@ -70,6 +70,17 @@ class TrainingConfig:
     zero1: bool = False  # shard optimizer state over the data axis (ZeRO-1)
     fsdp: bool = False  # shard params+grads+opt state over data (FSDP/ZeRO-3;
     #                     subsumes zero1)
+    fsdp_overlap: bool = False  # decomposed-FSDP execution
+    #                             (parallel/overlap.py): the scanned block
+    #                             stack prefetches layer k+1's weight gather
+    #                             under layer k's compute and drains layer
+    #                             k's grad reduction under layer k-1's
+    #                             backward. Implies --fsdp; needs
+    #                             --scan_layers; data-only meshes
+    xla_overlap_flags: bool = False  # set the XLA latency-hiding-scheduler
+    #                                  flag pack (async collectives overlap
+    #                                  with compute) before backend init;
+    #                                  runtime/context.py logs what was set
     remat: bool = False  # rematerialise blocks (peak-memory for FLOPs trade;
     #                      long-context entries default it on regardless)
     scan_layers: bool = False  # drive the transformer block stack as ONE
@@ -110,6 +121,13 @@ class TrainingConfig:
     #                              scalar from the step N-K dispatch each
     #                              iteration, capping host-side buffer growth
     #                              and carrying the device-side stop agreement
+
+    def __post_init__(self) -> None:
+        # --fsdp_overlap is an execution strategy FOR the FSDP layout: the
+        # sharded stacked weights it gathers only exist under --fsdp, so
+        # the flag implies it (the same way --fsdp subsumes --zero1)
+        if self.fsdp_overlap:
+            self.fsdp = True
 
     @property
     def data_axis_size(self) -> int:
@@ -211,6 +229,25 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "data axis (FSDP/ZeRO-3): per-chip model memory "
                         "divided by the DP degree; GSPMD inserts the "
                         "gather/scatter protocol. Subsumes --zero1.")
+    p.add_argument("--fsdp_overlap", action="store_true",
+                   help="Decomposed-FSDP execution (parallel/overlap.py): "
+                        "the scanned transformer stack gathers layer k+1's "
+                        "weights under layer k's compute and drains layer "
+                        "k's grad reduction under layer k-1's backward, so "
+                        "the collectives hide behind the matmuls instead "
+                        "of serialising before them. Implies --fsdp; "
+                        "requires --scan_layers; transformer families on "
+                        "data-only meshes. Gathered weights never exceed "
+                        "two layers live.")
+    p.add_argument("--xla_overlap_flags", action="store_true",
+                   help="Append the XLA latency-hiding-scheduler flag "
+                        "pack (async collectives overlapped with compute) "
+                        "to XLA_FLAGS before backend init — the compiler "
+                        "half of --fsdp_overlap. Applied only when a TPU "
+                        "plugin is importable and the CPU backend is not "
+                        "forced (unknown flags are FATAL to other "
+                        "backends); the runtime logs exactly what was set "
+                        "or why it was skipped.")
     p.add_argument("--fused_head", action="store_true",
                    help="Compute the LM head blockwise over the vocab "
                         "(ops/lm_head.py): the (B,T,V) logits tensor never "
@@ -265,13 +302,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="Capture a profiler trace over N steps (from step 10).")
     p.add_argument("--divergence_check_steps", type=int, default=0,
                    help="Cross-host replicated-state fingerprint check every N steps.")
-    p.add_argument("--preempt_sync_steps", type=int, default=8,
-                   help="Accepted for compatibility; unused. Multi-process "
+    p.add_argument("--preempt_sync_steps", type=int, default=None,
+                   help="DEPRECATED, accepted-and-unused. Multi-process "
                         "SIGTERM agreement now travels inside the jitted "
                         "train step (a device-side reduction over per-"
                         "process stop votes) and is read through the "
                         "bounded dispatch-depth barrier, so no host "
-                        "allgather cadence exists anymore.")
+                        "allgather cadence exists anymore. Passing the "
+                        "flag logs a one-time deprecation warning.")
     p.add_argument("--telemetry", type=str, default="async",
                    choices=["async", "sync"],
                    help="Scalar sink for logging_steps: 'async' hands device "
@@ -292,5 +330,21 @@ def build_arg_parser() -> argparse.ArgumentParser:
 
 def parse_args(argv: list[str] | None = None) -> TrainingConfig:
     ns = build_arg_parser().parse_args(argv)
+    if ns.preempt_sync_steps is not None:
+        # accepted-and-unused since the host-sync-free hot loop landed;
+        # silently ignoring an explicit flag hides dead config from the
+        # user, so say so ONCE (warnings dedupe repeat emissions)
+        import warnings
+
+        warnings.warn(
+            "--preempt_sync_steps is deprecated and has no effect: the "
+            "SIGTERM stop agreement rides inside the jitted train step "
+            "(device-side vote reduction read through the dispatch-depth "
+            "barrier); drop the flag",
+            DeprecationWarning,
+            stacklevel=2,
+        )
+    else:
+        ns.preempt_sync_steps = 8  # dataclass default, for config dumps
     known = {f.name for f in dataclasses.fields(TrainingConfig)}
     return TrainingConfig(**{k: v for k, v in vars(ns).items() if k in known})

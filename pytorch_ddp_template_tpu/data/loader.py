@@ -85,10 +85,14 @@ class ShardedLoader:
         # compute when prefetching); consumer_wait_s is time the *training
         # loop* actually stalled waiting on this loader — the number that
         # belongs in host-overhead attribution (engine logs it per interval
-        # as input_wait_ms). Plain float adds under the GIL: safe enough
-        # for telemetry across the producer/consumer threads.
+        # as input_wait_ms); producer_idle_s is time the prefetch thread
+        # sat blocked on a full queue (compute-bound regime: large values
+        # here with ~zero consumer_wait_s mean the input path has slack).
+        # Plain float adds under the GIL: safe enough for telemetry across
+        # the producer/consumer threads.
         self.stats: dict[str, float] = {
-            "gather_s": 0.0, "consumer_wait_s": 0.0, "batches": 0.0,
+            "gather_s": 0.0, "consumer_wait_s": 0.0,
+            "producer_idle_s": 0.0, "batches": 0.0,
         }
         self.accum_steps = int(accum_steps)
         if self.accum_steps < 1:
@@ -247,13 +251,19 @@ class ShardedLoader:
             # bounded put that aborts when the consumer is gone, so an
             # abandoned generator (early break, partial iteration) never
             # leaves this thread pinned on a full queue
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.05)
-                    return True
-                except queue.Full:
-                    continue
-            return False
+            t0 = time.perf_counter()
+            try:
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.05)
+                        return True
+                    except queue.Full:
+                        continue
+                return False
+            finally:
+                # time blocked on a full queue (the fast path's put is
+                # ~instant, so the accumulated value reads as idle time)
+                self.stats["producer_idle_s"] += time.perf_counter() - t0
 
         def producer() -> None:
             try:

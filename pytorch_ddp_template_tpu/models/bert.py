@@ -44,6 +44,9 @@ class BertEncoder(nn.Module):
     # scan-over-layers (models/transformer.py): one compiled block over
     # (num_layers, ...)-stacked weights — O(1) compile time in depth
     scan_layers: bool = False
+    # decomposed FSDP (--fsdp_overlap, parallel/overlap.py): prefetched
+    # per-layer weight gathers + overlapped grad drain; needs scan_layers
+    fsdp_overlap: bool = False
     # blockwise tied MLM head (ops/lm_head.py): return the transformed
     # head hidden states; the task applies table+bias vocab-block-wise,
     # so the (B, T, V) logits tensor never exists
@@ -78,6 +81,7 @@ class BertEncoder(nn.Module):
             mesh=self.mesh,
             remat=self.remat,
             scan_layers=self.scan_layers,
+            fsdp_overlap=self.fsdp_overlap,
             name="encoder",
         )
         self.mlm_ln = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")

@@ -44,6 +44,9 @@ class GptDecoder(nn.Module):
     # instead of num_layers unrolled copies: O(1) compile time in depth,
     # remat-scan memory profile when composed with remat (--scan_layers)
     scan_layers: bool = False
+    # decomposed FSDP (--fsdp_overlap, parallel/overlap.py): prefetched
+    # per-layer weight gathers + overlapped grad drain; needs scan_layers
+    fsdp_overlap: bool = False
     # blockwise tied head (ops/lm_head.py): the model returns final hidden
     # states and the task computes cross-entropy vocab-block-wise — the
     # (B, T, V) logits tensor never exists. The memory enabler for the
@@ -81,6 +84,7 @@ class GptDecoder(nn.Module):
             remat=self.remat,
             moe_experts=self.moe_experts,
             scan_layers=self.scan_layers,
+            fsdp_overlap=self.fsdp_overlap,
             name="decoder",
         )(x, train=train)
         x = nn.LayerNorm(dtype=jnp.float32, name="final_ln")(x)

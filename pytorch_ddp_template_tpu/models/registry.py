@@ -87,6 +87,35 @@ def build(name: str, config: TrainingConfig, mesh=None) -> tuple[Task, Dataset]:
                 "stack to scan (transformer families only)"
             )
         task.model = task.model.clone(scan_layers=True)
+    if config.fsdp_overlap:
+        if not config.scan_layers:
+            raise ValueError(
+                "--fsdp_overlap needs --scan_layers: the stacked "
+                "(num_layers, ...) weight layout IS the unit of the "
+                "prefetch schedule (and keeps checkpoints in the scanned "
+                "layout); pass both flags"
+            )
+        if not hasattr(task.model, "fsdp_overlap"):
+            raise ValueError(
+                f"--fsdp_overlap: model {name!r} "
+                f"({type(task.model).__name__}) has no decomposed-FSDP "
+                "execution path (transformer families only)"
+            )
+        if getattr(task.model, "moe_experts", 0):
+            raise ValueError(
+                "--fsdp_overlap does not compose with MoE entries yet "
+                "(sown load-balance losses and expert dispatch need "
+                "in-region handling); drop one of the two"
+            )
+        from ..parallel.overlap import validate_overlap_mesh
+        from ..runtime import make_mesh
+
+        import jax
+
+        if mesh is None:
+            mesh = make_mesh(config.mesh, jax.devices())
+        validate_overlap_mesh(mesh)  # fail fast, before any tracing
+        task.model = task.model.clone(fsdp_overlap=True, mesh=mesh)
     if config.data_dir:
         from ..data.filestore import MemmapDataset
 

@@ -218,6 +218,63 @@ class TransformerEncoder(nn.Module):
     remat: bool = False
     moe_experts: int = 0
     scan_layers: bool = False
+    # decomposed-FSDP execution (--fsdp_overlap, parallel/overlap.py):
+    # explicit per-layer weight gathers pipelined one layer ahead of
+    # compute, grad scatters drained under the previous layer's backward.
+    # Requires scan_layers (the stacked layout IS the schedule's unit) and
+    # a data-only mesh; init still runs through nn.scan so the param
+    # layout, checkpoints and Task.init interchangeability are unchanged.
+    fsdp_overlap: bool = False
+
+    def _overlap_forward(self, block_cls, x, mask, train):
+        """Drive the stacked block via ``parallel.overlap.overlap_scan``
+        instead of ``nn.scan``: same weights, same math, explicit
+        prefetch schedule. Numerics match the nn.scan path bit-for-bit in
+        eval mode and dropout-free training; with dropout active the
+        per-layer streams are folded from the layer index rather than
+        nn.scan's split — statistically equivalent, not bit-identical."""
+        from ..parallel.overlap import overlap_scan, validate_overlap_mesh
+
+        if self.moe_experts:
+            raise ValueError(
+                "--fsdp_overlap does not compose with MoE blocks yet (the "
+                "sown load-balance losses and expert dispatch need "
+                "in-region handling); drop one of the two"
+            )
+        validate_overlap_mesh(self.mesh)
+        stacked = nn.meta.unbox(
+            self.scope.get_variable("params", SCAN_LAYER_AXIS))
+        if stacked is None:
+            raise ValueError(
+                "fsdp_overlap apply found no stacked "
+                f"'{SCAN_LAYER_AXIS}' params — was the model initialised "
+                "with scan_layers?"
+            )
+        block = block_cls(
+            self.num_heads, self.head_dim, self.mlp_dim, self.dtype,
+            self.dropout_rate, self.pre_norm, self.attn_impl, self.mesh,
+            self.causal, moe_experts=self.moe_experts,
+            parent=None, name=SCAN_LAYER_AXIS,
+        )
+        dropout_rng = None
+        if train and self.dropout_rate and self.has_rng("dropout"):
+            dropout_rng = self.make_rng("dropout")
+
+        def apply_one(w, y, k, extras):
+            mask, base_rng = extras
+            rngs = (None if base_rng is None
+                    else {"dropout": jax.random.fold_in(base_rng, k)})
+            # positional train: the remat wrapper pins it static via
+            # static_argnums=(3,) (self counts as argnum 0)
+            if self.remat:
+                return block.apply({"params": w}, y, mask, train, rngs=rngs)
+            return block.apply({"params": w}, y, mask, train=train,
+                               rngs=rngs)
+
+        # mask/rng ride as explicit custom_vjp args (tracers must not be
+        # closed over); None entries vanish from the pytree harmlessly
+        return overlap_scan(apply_one, stacked, x, (mask, dropout_rng),
+                            self.mesh)
 
     @nn.compact
     def __call__(self, x, mask=None, *, train: bool = True):
@@ -225,6 +282,8 @@ class TransformerEncoder(nn.Module):
         if self.remat:
             block_cls = nn.remat(EncoderBlock, static_argnums=(3,))
         if self.scan_layers:
+            if self.fsdp_overlap and not self.is_initializing():
+                return self._overlap_forward(block_cls, x, mask, train)
             block = block_cls(
                 self.num_heads, self.head_dim, self.mlp_dim, self.dtype,
                 self.dropout_rate, self.pre_norm, self.attn_impl, self.mesh,

@@ -14,6 +14,8 @@ ImageNet, bf16 + grad accumulation". TPU-first choices:
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
 import jax.numpy as jnp
 
@@ -35,6 +37,12 @@ class VisionTransformer(nn.Module):
     # scan-over-layers (models/transformer.py): one compiled block over
     # (num_layers, ...)-stacked weights — O(1) compile time in depth
     scan_layers: bool = False
+    # decomposed FSDP (--fsdp_overlap, parallel/overlap.py): prefetched
+    # per-layer weight gathers + overlapped grad drain; needs scan_layers.
+    # The mesh rides along only for this mode (ViT has no context-parallel
+    # attention to thread it for otherwise).
+    fsdp_overlap: bool = False
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x, *, train: bool = True):
@@ -78,8 +86,10 @@ class VisionTransformer(nn.Module):
             dropout_rate=self.dropout_rate,
             pre_norm=True,
             attn_impl=self.attn_impl,
+            mesh=self.mesh,
             remat=self.remat,
             scan_layers=self.scan_layers,
+            fsdp_overlap=self.fsdp_overlap,
             name="encoder",
         )(x, train=train)
 

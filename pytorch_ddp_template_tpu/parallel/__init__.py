@@ -12,6 +12,7 @@ over the ``expert`` axis (``expert.py``).
 """
 
 from .expert import expert_apply, stack_expert_params
+from .overlap import hlo_overlap_evidence, overlap_scan, validate_overlap_mesh
 from .pipeline import pipeline_apply, stack_stage_params
 from .ring import ring_attention, ring_attention_local
 from .sharding import (
@@ -19,6 +20,7 @@ from .sharding import (
     active_rules,
     describe,
     fsdp_reshard,
+    fsdp_split_dim,
     logical_shardings,
     shard_tree,
     zero1_reshard,
@@ -31,7 +33,10 @@ __all__ = [
     "describe",
     "expert_apply",
     "fsdp_reshard",
+    "fsdp_split_dim",
+    "hlo_overlap_evidence",
     "logical_shardings",
+    "overlap_scan",
     "stack_expert_params",
     "pipeline_apply",
     "ring_attention",
@@ -39,5 +44,6 @@ __all__ = [
     "stack_stage_params",
     "shard_tree",
     "ulysses_attention",
+    "validate_overlap_mesh",
     "zero1_reshard",
 ]

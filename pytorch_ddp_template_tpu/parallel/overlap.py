@@ -1,0 +1,417 @@
+"""Decomposed FSDP: explicit per-layer weight gathers, pipelined one layer
+ahead of compute (``--fsdp_overlap``).
+
+Under plain ``--fsdp`` the gather/scatter protocol is left entirely to
+GSPMD, whose default dataflow is "all-gather layer k → compute layer k":
+the ICI sits idle during every layer's matmuls and the matmuls wait on
+every gather. ZeRO (Rajbhandari et al., 2020) and "Overlap Communication
+with Dependent Computation via Decomposition" (Wang et al., ASPLOS 2023)
+show the win comes from *decomposing* the schedule: issue layer k+1's
+parameter gather while layer k computes, and drain layer k's gradient
+reduction while layer k−1's backward runs. The scan-over-layers layout
+(``--scan_layers``: every block weight stacked on a leading
+``(num_layers, ...)`` dim, FSDP-split via ``fsdp_reshard(prefer_dim=0)``)
+provides exactly the uniform per-layer structure this needs.
+
+Mechanism (all through the ``shard_map_compat`` seam, over the ``data``
+mesh axis):
+
+- :func:`make_layer_gather` builds ``gather(stacked, k) -> layer_k`` as a
+  ``shard_map`` region whose per-leaf body depends on where the FSDP
+  split landed (``fsdp_split_dim`` — the same chooser ``fsdp_reshard``
+  uses, so the specs match the layouts the trainer placed and no silent
+  reshard happens at the boundary):
+
+  * split on the stacked **layer dim** (the ``prefer_dim=0`` case,
+    ``num_layers % data == 0``): the owner shard contributes its slice,
+    everyone else zeros, one ``psum`` broadcasts it — a
+    gather-at-layer-granularity;
+  * split on a **within-layer** dim (the fallback when the layer count
+    does not divide, e.g. 2-layer models on 8 chips): slice the layer
+    locally, ``all_gather`` the split dim — the classic FSDP unshard;
+  * unsplit leaves (odd shapes): a plain slice, no collective.
+
+- The gather carries a ``jax.custom_vjp``: the backward is the symmetric
+  scatter — the incoming per-layer cotangent (which GSPMD reduces across
+  the ``data`` axis to satisfy the region's replicated in-spec: the
+  per-layer gradient reduction) is written into the owner shard's slice /
+  chunked back into the split-dim layout, i.e. a reduce-scatter of layer
+  k's grads delivered straight into the sharded stacked layout. Explicit
+  custom_vjp rather than shard_map transposition so the backward schedule
+  is pinned by construction, not by transpose-rule internals.
+
+- :func:`overlap_scan` drives the block over layers with a ``lax.scan``
+  whose carry holds ``(activations, next layer's gathered weights)``: the
+  body issues the gather for layer k+1 *before* layer k's compute, so the
+  two are dataflow-independent inside one loop iteration and the XLA
+  latency-hiding scheduler (``--xla_overlap_flags``) can run the
+  collective under the matmuls. Reverse-mode through the scan gives the
+  mirrored property: layer k's grad scatter is independent of layer k−1's
+  backward compute. Gathered full weights never live longer than two
+  layers (current + prefetched) — memory stays O(2/L) above sharded
+  FSDP, never the O(1) full materialisation.
+
+Numerics: the gather reproduces ``stacked[k]`` bit-exactly (a psum of one
+non-zero contribution, or an all-gather of exact chunks), so the overlap
+path is bit-identical to the GSPMD-default FSDP path in eval mode and
+dropout-free training. With dropout active the per-layer streams are
+folded from the scan index rather than ``nn.scan``'s split — statistically
+equivalent, not bit-interchangeable (documented in README).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..runtime.context import DATA_AXIS
+from .shard_map_compat import shard_map
+from .sharding import fsdp_split_dim
+
+#: sentinel for "leaf not split over data" in the static dims tree
+#: (None cannot ride in a pytree — it reads as an empty subtree)
+UNSPLIT = -1
+
+
+def validate_overlap_mesh(mesh: Mesh | None) -> Mesh:
+    """Refuse meshes the decomposed path cannot serve, with intent.
+
+    The gather/scatter regions replicate weights over ``data`` only; a
+    live ``model``/``seq``/... axis would be silently un-sharded by the
+    replicated out-specs — TP composed with decomposed FSDP needs
+    within-region handling this v1 does not implement.
+    """
+    if mesh is None:
+        raise ValueError(
+            "--fsdp_overlap needs the device mesh threaded into the model "
+            "(models/registry.py does this; pass mesh= when building "
+            "directly)"
+        )
+    extra = {name: size for name, size in mesh.shape.items()
+             if name != DATA_AXIS and size > 1}
+    if extra:
+        raise ValueError(
+            f"--fsdp_overlap supports data-axis FSDP only; mesh also has "
+            f"{extra} — drop the extra axes or drop --fsdp_overlap"
+        )
+    return mesh
+
+
+def overlap_split_dims(stacked: Any, data_size: int) -> Any:
+    """Static per-leaf FSDP split dims for a stacked ``(L, ...)`` tree.
+
+    Mirrors ``fsdp_reshard(prefer_dim=0)`` leaf-for-leaf via the shared
+    :func:`fsdp_split_dim` chooser; ``UNSPLIT`` marks replicated leaves.
+    """
+    return jax.tree.map(
+        lambda x: (lambda d: UNSPLIT if d is None else d)(
+            fsdp_split_dim(x.shape, data_size, prefer_dim=0)),
+        stacked,
+    )
+
+
+def make_layer_gather(mesh: Mesh, stacked: Any, num_layers: int,
+                      ) -> tuple[Callable[[Any, jax.Array], Any],
+                                 Callable[[Any, jax.Array], Any]]:
+    """Build the ``(gather, scatter)`` pair for one stacked layer tree.
+
+    ``gather(stacked, k) -> layer_k`` unshards layer ``k``'s weights;
+    ``scatter(g, k) -> stacked-layout grad`` writes a full per-layer
+    cotangent back into the sharded stacked layout (zeros elsewhere) —
+    the scatter half of the reduce-scatter (the reduce is the GSPMD
+    cross-replica sum the replicated in-spec forces on ``g``). Both are
+    called as plain forward computations by :func:`overlap_scan`'s
+    custom-vjp rules; nothing differentiates through them.
+
+    ``stacked`` is used for shapes/structure only (trace-time); the
+    returned callables take the live tree. Specs are computed from the
+    same split-dim chooser ``fsdp_reshard(prefer_dim=0)`` uses, so on a
+    state the trainer placed the region boundary is a no-op reshard.
+    """
+    data_size = mesh.shape.get(DATA_AXIS, 1)
+    dims = overlap_split_dims(stacked, data_size)
+
+    def leaf_spec(x, d):
+        spec: list[Any] = [None] * x.ndim
+        if d != UNSPLIT:
+            spec[d] = DATA_AXIS
+        return P(*spec)
+
+    in_specs = jax.tree.map(leaf_spec, stacked, dims)
+    rep_specs = jax.tree.map(lambda _: P(), stacked)
+
+    def _gather_leaf(local: jax.Array, k: jax.Array, d: int) -> jax.Array:
+        if d == 0:
+            # layer-granular split: broadcast the owner shard's slice
+            per = num_layers // data_size
+            me = lax.axis_index(DATA_AXIS)
+            owner = k // per
+            mine = lax.dynamic_index_in_dim(
+                local, jnp.clip(k - owner * per, 0, per - 1), 0,
+                keepdims=False)
+            return lax.psum(
+                jnp.where(owner == me, mine, jnp.zeros_like(mine)),
+                DATA_AXIS)
+        sliced = lax.dynamic_index_in_dim(local, k, 0, keepdims=False)
+        if d == UNSPLIT:
+            return sliced
+        # within-layer split: the classic FSDP all-gather of the chunk dim
+        return lax.all_gather(sliced, DATA_AXIS, axis=d - 1, tiled=True)
+
+    def _scatter_leaf(g: jax.Array, k: jax.Array, d: int) -> jax.Array:
+        if d == 0:
+            per = num_layers // data_size
+            me = lax.axis_index(DATA_AXIS)
+            owner = k // per
+            upd = jnp.where(owner == me, g, jnp.zeros_like(g))
+            zeros = jnp.zeros((per,) + g.shape, g.dtype)
+            return lax.dynamic_update_index_in_dim(
+                zeros, upd, jnp.clip(k - owner * per, 0, per - 1), 0)
+        if d == UNSPLIT:
+            zeros = jnp.zeros((num_layers,) + g.shape, g.dtype)
+            return lax.dynamic_update_index_in_dim(zeros, g, k, 0)
+        chunk = g.shape[d - 1] // data_size
+        me = lax.axis_index(DATA_AXIS)
+        mine = lax.dynamic_slice_in_dim(g, me * chunk, chunk, axis=d - 1)
+        local = jnp.zeros((num_layers,) + mine.shape, mine.dtype)
+        return lax.dynamic_update_index_in_dim(local, mine, k, 0)
+
+    def _fwd_local(tree: Any, k: jax.Array) -> Any:
+        return jax.tree.map(lambda x, d: _gather_leaf(x, k, d), tree, dims)
+
+    def _bwd_local(g: Any, k: jax.Array) -> Any:
+        return jax.tree.map(lambda x, d: _scatter_leaf(x, k, d), g, dims)
+
+    gather = shard_map(_fwd_local, mesh=mesh,
+                       in_specs=(in_specs, P()), out_specs=rep_specs,
+                       check_vma=False)
+    scatter = shard_map(_bwd_local, mesh=mesh,
+                        in_specs=(rep_specs, P()), out_specs=in_specs,
+                        check_vma=False)
+    return gather, scatter
+
+
+def _zero_cotangent(tree: Any) -> Any:
+    """Symbolic-zero cotangents: float0 for int/bool leaves (indices,
+    masks, rng keys), real zeros for any inexact leaf."""
+    def z(v):
+        if jnp.issubdtype(jnp.result_type(v), jnp.inexact):
+            return jnp.zeros_like(v)
+        return np.zeros(np.shape(v), jax.dtypes.float0)
+    return jax.tree.map(z, tree)
+
+
+def overlap_scan(apply_fn: Callable[[Any, jax.Array, jax.Array, Any],
+                                    jax.Array],
+                 stacked: Any, x: jax.Array, extras: Any,
+                 mesh: Mesh) -> jax.Array:
+    """Run ``apply_fn(layer_params, x, k, extras)`` over the stacked
+    layers with a one-layer-ahead gather pipeline and a hand-written
+    (custom-vjp) backward.
+
+    Forward: the scan carry holds ``(activations, gathered weights for
+    the layer about to run)``; each body issues the NEXT layer's gather
+    before the current layer's compute (the two are dataflow-independent
+    inside one loop iteration), so at most two layers' full weights exist
+    at any instant. The final iteration re-gathers the last layer
+    (clamped index) to keep the body uniform — one redundant collective
+    per step, never a shape change.
+
+    Backward (the custom-vjp rule — NOT autodiff through the forward
+    scan, which would stack every iteration's gathered weights into an
+    O(L) unsharded residual): a reverse scan whose carry pipelines the
+    re-gather of layer k−1's weights under layer k's backward compute,
+    recomputes the block forward from the saved layer-boundary
+    activation (so the only O(L) residual is activations — the
+    remat-scan profile; intra-block residuals are recomputed per layer,
+    which also means ``--fsdp_overlap`` implicitly carries block-level
+    remat), and scatters layer k's weight grads into the sharded stacked
+    layout every iteration — the per-layer reduce-scatter drain, issued while
+    the next (earlier) layer's backward still has compute in flight.
+
+    ``extras`` carries every traced auxiliary input the block consumes
+    (attention mask, dropout rng): custom_vjp forbids closing over
+    tracers, so they ride as explicit primal args with symbolic-zero
+    cotangents.
+    """
+    validate_overlap_mesh(mesh)
+    leaves = jax.tree.leaves(stacked)
+    if not leaves:
+        raise ValueError("overlap_scan: empty stacked parameter tree")
+    num_layers = int(leaves[0].shape[0])
+    gather, scatter = make_layer_gather(mesh, stacked, num_layers)
+    ks = jnp.arange(num_layers, dtype=jnp.int32)
+
+    @jax.custom_vjp
+    def run(stacked, x, extras):
+        w0 = gather(stacked, jnp.asarray(0, jnp.int32))
+
+        def body(carry, k):
+            y, w = carry
+            # prefetch FIRST: independent of this layer's compute by
+            # construction, visible as such in the lowered while body
+            w_next = gather(stacked, jnp.minimum(k + 1, num_layers - 1))
+            y = apply_fn(w, y, k, extras)
+            return (y, w_next), None
+
+        (y, _), _ = lax.scan(body, (x, w0), ks)
+        return y
+
+    def run_fwd(stacked, x, extras):
+        w0 = gather(stacked, jnp.asarray(0, jnp.int32))
+
+        def body(carry, k):
+            y, w = carry
+            w_next = gather(stacked, jnp.minimum(k + 1, num_layers - 1))
+            y_out = apply_fn(w, y, k, extras)
+            # collect each layer's INPUT activation: the boundary
+            # residual the backward recomputes from
+            return (y_out, w_next), y
+
+        (y, _), xs = lax.scan(body, (x, w0), ks)
+        return y, (stacked, xs, extras)
+
+    def run_bwd(res, gy):
+        stacked, xs, extras = res
+        w_last = gather(stacked, jnp.asarray(num_layers - 1, jnp.int32))
+        gacc = jax.tree.map(jnp.zeros_like, stacked)
+
+        def body(carry, inputs):
+            gy, w, gacc = carry
+            k, x_k = inputs
+            # prefetch the PREVIOUS layer's weights under this layer's
+            # backward compute — the mirror of the forward pipeline
+            w_prev = gather(stacked, jnp.maximum(k - 1, 0))
+            _, pullback = jax.vjp(
+                lambda w_, x_: apply_fn(w_, x_, k, extras), w, x_k)
+            gw, gx = pullback(gy)
+            # per-layer drain: the cross-replica reduction GSPMD emits to
+            # replicate gw, then the owner-shard write — layer k's grads
+            # reach the sharded stacked layout while layer k−1's backward
+            # still has compute in flight
+            gacc = jax.tree.map(jnp.add, gacc, scatter(gw, k))
+            return (gx, w_prev, gacc), None
+
+        (gx, _, gacc), _ = lax.scan(
+            body, (gy, w_last, gacc), (ks, xs), reverse=True)
+        return gacc, gx, _zero_cotangent(extras)
+
+    run.defvjp(run_fwd, run_bwd)
+    return run(stacked, x, extras)
+
+
+# -- HLO schedule evidence -------------------------------------------------
+
+def hlo_overlap_evidence(hlo_text: str) -> dict[str, Any]:
+    """Analyse compiled HLO for the decomposed schedule's signature.
+
+    For every non-entry computation that contains both matmuls and a
+    cross-replica collective (on this harness those are exactly the
+    layer-scan loop bodies, forward and backward), walk each collective's
+    operand chain and classify it as *compute-independent* (its inputs
+    reach only loop-carried state — the stacked params and the induction
+    variable, never a same-body dot) or *compute-dependent* (it consumes
+    this iteration's dots, e.g. the per-layer gradient reduction).
+
+    A compute-independent collective inside a dot-carrying loop body is
+    the schedulability witness: the latency-hiding scheduler may start it
+    at the top of the iteration and run the matmuls under it — the
+    layer-(k+1) weight gather issued before layer k's compute retires.
+    Dependent collectives (the backward grad drain) can only overlap
+    ACROSS iterations (start in iteration k, complete during k-1), which
+    instruction-level text cannot prove; their presence and count are
+    reported as-is. Whether overlap then *happens* is a
+    scheduler/hardware property — measured on TPU by
+    tools/tpu_followup_r8.sh; this function proves what the CPU host can:
+    the dataflow freedom exists.
+
+    Headline booleans: ``prefetch_gather_independent`` (≥1 loop body has
+    a compute-independent collective — the forward prefetch) and
+    ``bwd_regather_independent`` (≥2 such bodies — the backward re-gather
+    pipeline too).
+    """
+    import re
+
+    collectives = ("all-reduce", "all-gather", "reduce-scatter",
+                   "collective-permute")
+    bodies = []
+    cur: list[str] | None = None
+    name = ""
+    for line in hlo_text.splitlines():
+        stripped = line.strip()
+        if stripped.endswith("{") and ("(" in stripped and "->" in stripped):
+            cur = []
+            name = stripped.split(" ", 1)[0]
+            continue
+        if stripped == "}" or stripped.startswith("}"):
+            if cur:
+                bodies.append((name, cur))
+            cur = None
+            continue
+        if cur is not None and "=" in stripped:
+            cur.append(stripped)
+
+    def is_dot(s: str) -> bool:
+        return " dot(" in s or " convolution(" in s
+
+    def is_collective(s: str) -> bool:
+        return any(f" {c}(" in s or f" {c}-start(" in s
+                   for c in collectives)
+
+    token = re.compile(r"%[\w.\-]+")
+    rows = []
+    for body_name, instrs in bodies:
+        if body_name.upper().startswith("ENTRY"):
+            # entry holds the pre-loop warm gather and the optimizer
+            # tail — not a layer-schedule witness either way
+            continue
+        defs: dict[str, tuple[list[str], str]] = {}
+        for s in instrs:
+            lhs, _, rhs = s.partition("=")
+            names = token.findall(lhs)
+            if not names:
+                continue
+            # operands: %refs on the RHS; refs to other computations
+            # (calls=, to_apply=) simply miss the defs map and end the walk
+            defs[names[0]] = (token.findall(rhs), s)
+        dot_names = {n for n, (_, s) in defs.items() if is_dot(s)}
+        coll_names = [n for n, (_, s) in defs.items() if is_collective(s)]
+        if not dot_names or not coll_names:
+            continue
+
+        dep_cache: dict[str, bool] = {}
+
+        def depends_on_dot(n: str) -> bool:
+            if n in dep_cache:
+                return dep_cache[n]
+            dep_cache[n] = False  # cycles impossible in HLO; guards re-entry
+            if n in dot_names:
+                dep_cache[n] = True
+                return True
+            ops = defs.get(n, ([], ""))[0]
+            dep_cache[n] = any(depends_on_dot(o) for o in ops)
+            return dep_cache[n]
+
+        independent = [n for n in coll_names
+                       if not any(depends_on_dot(o)
+                                  for o in defs[n][0])]
+        rows.append({
+            "computation": body_name,
+            "dots": len(dot_names),
+            "collectives": len(coll_names),
+            "compute_independent_collectives": len(independent),
+            "compute_dependent_collectives":
+                len(coll_names) - len(independent),
+        })
+    with_indep = [r for r in rows
+                  if r["compute_independent_collectives"] > 0]
+    return {
+        "bodies": rows,
+        "prefetch_gather_independent": len(with_indep) >= 1,
+        "bwd_regather_independent": len(with_indep) >= 2,
+    }

@@ -87,6 +87,35 @@ def shard_tree(tree: Any, mesh: Mesh,
     return jax.device_put(nn.meta.unbox(tree), shardings)
 
 
+def fsdp_split_dim(shape: Sequence[int], data_size: int,
+                   prefer_dim: int | None = None,
+                   free: Sequence[bool] | None = None) -> int | None:
+    """Which dim of ``shape`` the FSDP split lands on, or None.
+
+    The single source of truth for the split-dim choice, shared between
+    :func:`_shard_free_dim_over_data` (which places the data) and
+    ``parallel/overlap.py`` (which must compute the SAME layout statically
+    to build matching ``shard_map`` specs — a mismatch there would mean a
+    silent reshard at every gather). Rules: only ``free`` dims whose size
+    ``data_size`` divides are candidates; ``prefer_dim`` wins when it
+    qualifies; otherwise the largest dim wins, ties keeping the earliest.
+    """
+    if data_size == 1 or not shape:
+        return None
+    free = [True] * len(shape) if free is None else list(free)
+
+    def ok(i):
+        return free[i] and shape[i] >= data_size and shape[i] % data_size == 0
+
+    if prefer_dim is not None and prefer_dim < len(shape) and ok(prefer_dim):
+        return prefer_dim
+    best = None
+    for i, dim in enumerate(shape):
+        if ok(i) and (best is None or dim > shape[best]):
+            best = i
+    return best
+
+
 def _shard_free_dim_over_data(tree: Any, mesh: Mesh,
                               prefer_dim: int | None = None) -> Any:
     """Shard each leaf's *largest* dividable free dim over ``data``.
@@ -127,19 +156,8 @@ def _shard_free_dim_over_data(tree: Any, mesh: Mesh,
         if DATA_AXIS in used:
             return x
 
-        def free_and_dividable(i):
-            return (spec[i] is None and x.shape[i] >= data_size
-                    and x.shape[i] % data_size == 0)
-
-        best = None
-        if (prefer_dim is not None and prefer_dim < x.ndim
-                and free_and_dividable(prefer_dim)):
-            best = prefer_dim
-        else:
-            for i, dim in enumerate(x.shape):
-                if free_and_dividable(i):
-                    if best is None or dim > x.shape[best]:
-                        best = i
+        best = fsdp_split_dim(x.shape, data_size, prefer_dim,
+                              free=[s is None for s in spec])
         if best is not None:
             spec[best] = DATA_AXIS
             return jax.device_put(x, NamedSharding(mesh, P(*spec)))
@@ -184,13 +202,48 @@ def fsdp_reshard(tree: Any, mesh: Mesh,
     return _shard_free_dim_over_data(tree, mesh, prefer_dim)
 
 
-def describe(mesh: Mesh) -> dict[str, Any]:
-    """Human-readable sharding summary for the startup log."""
+def describe(mesh: Mesh, config: Any = None,
+             params: Any = None) -> dict[str, Any]:
+    """Human-readable sharding summary for the startup log.
+
+    With ``config`` (a ``TrainingConfig``) the summary also names the
+    active FSDP execution mode — ``"decomposed-prefetch"`` under
+    ``--fsdp_overlap`` (explicit one-layer-ahead gathers,
+    ``parallel/overlap.py``) vs ``"gspmd-default"`` — and, when ``params``
+    are supplied as well, a histogram of which dim each leaf's FSDP split
+    landed on (``{"dim0": 12, "unsplit": 3}``-style), so a run's log
+    records the layer-granular-vs-within-layer layout decision.
+    """
     sizes = dict(mesh.shape)
-    return {
+    out: dict[str, Any] = {
         "mesh": sizes,
         "data_parallel": sizes.get(DATA_AXIS, 1),
         "tensor_parallel": sizes.get(MODEL_AXIS, 1),
         "context_parallel": sizes.get(SEQ_AXIS, 1),
         "expert_parallel": sizes.get(EXPERT_AXIS, 1),
     }
+    if config is not None:
+        if getattr(config, "fsdp", False):
+            out["fsdp_mode"] = ("decomposed-prefetch"
+                                if getattr(config, "fsdp_overlap", False)
+                                else "gspmd-default")
+        elif getattr(config, "zero1", False):
+            out["fsdp_mode"] = "zero1"
+        if getattr(config, "fsdp", False) and params is not None:
+            # read the PLACED shardings, not a re-derivation: under TP some
+            # dims already carry the model axis and the chooser would lie
+            # about them — the log must record where the data split
+            # actually landed
+            hist: dict[str, int] = {}
+            for leaf in jax.tree.leaves(nn.meta.unbox(params)):
+                spec = tuple(getattr(getattr(leaf, "sharding", None),
+                                     "spec", ()) or ())
+                key = "unsplit"
+                for i, s in enumerate(spec):
+                    names = (s,) if isinstance(s, str) else tuple(s or ())
+                    if DATA_AXIS in names:
+                        key = f"dim{i}"
+                        break
+                hist[key] = hist.get(key, 0) + 1
+            out["fsdp_split_dims"] = dict(sorted(hist.items()))
+    return out

@@ -118,6 +118,47 @@ class RuntimeContext:
 
 _initialized = False
 
+#: the XLA latency-hiding-scheduler pack (``--xla_overlap_flags``): lets the
+#: TPU scheduler run collectives asynchronously under compute — the
+#: compiler half of the decomposed-FSDP story (``parallel/overlap.py``
+#: makes the gathers *schedulable*; these flags make the scheduler *use*
+#: that freedom). The set follows the public MaxText/XLA guidance for
+#: overlapping FSDP collectives; unknown flags are rejected by the flag
+#: parser at backend init, which is why the pack is opt-in rather than
+#: always-on (CPU/GPU backends of other jaxlib builds may not know the
+#: tpu-prefixed ones).
+OVERLAP_XLA_FLAGS: tuple[str, ...] = (
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+    "--xla_enable_async_all_gather=true",
+)
+
+
+def apply_overlap_xla_flags() -> list[str]:
+    """Append :data:`OVERLAP_XLA_FLAGS` to ``XLA_FLAGS`` (idempotent).
+
+    Returns the flags actually added (already-present ones are skipped so
+    an operator's explicit setting wins). Must run BEFORE the first
+    backend touch — XLA reads the env exactly once at client init; the
+    CLI path (``ddp.py`` → ``runtime.init``) satisfies this, and the
+    startup log records what was set so a too-late call is auditable.
+    """
+    import os
+
+    current = os.environ.get("XLA_FLAGS", "")
+    # compare FLAG NAMES token-wise, not as substrings: a pack flag that
+    # prefixes an operator-set longer flag (…_fusion vs …_fusion_fuse_all_
+    # gather) must not be mistaken for already-present
+    current_names = {t.split("=", 1)[0] for t in current.split()}
+    added = [f for f in OVERLAP_XLA_FLAGS
+             if f.split("=", 1)[0] not in current_names]
+    if added:
+        os.environ["XLA_FLAGS"] = (current + " " + " ".join(added)).strip()
+    return added
+
 
 def init(config: TrainingConfig) -> RuntimeContext:
     """Establish the distributed context. Reference: ``setup`` ddp.py:80-115.
@@ -129,6 +170,43 @@ def init(config: TrainingConfig) -> RuntimeContext:
     """
     global _initialized
     redirect_warnings_to_logger(log)
+    # Sharding-invariant PRNG. The legacy threefry lowering draws
+    # DIFFERENT bits once GSPMD spatially partitions a consumer: on a
+    # data:2,seq:2,model:2 mesh the jitted eval's MLM mask was a different
+    # (valid) 15% subset than the same seed drawn eagerly — the "numeric
+    # drift" that parked tests/test_eval_exact.py's seq-mesh case. The
+    # partitionable implementation's contract is bit-identical draws
+    # regardless of sharding; it changes every stream's values vs older
+    # releases (fresh runs only — checkpointed state is data, not seeds).
+    jax.config.update("jax_threefry_partitionable", True)
+    if config.xla_overlap_flags:
+        # unknown flags in XLA_FLAGS are FATAL at backend init (verified
+        # on this CPU jaxlib: "F ... Unknown flags in XLA_FLAGS"), so the
+        # TPU-oriented pack only applies when a TPU plugin can actually be
+        # the backend; the skip is logged so a mis-targeted run is
+        # auditable rather than silently unflagged
+        import importlib.util
+        import os as _os
+
+        cpu_forced = config.cpu or _os.environ.get(
+            "JAX_PLATFORMS", "").strip().lower() == "cpu"
+        has_tpu = any(importlib.util.find_spec(m) is not None
+                      for m in ("axon", "libtpu"))
+        if cpu_forced or not has_tpu:
+            log.warning(
+                "--xla_overlap_flags skipped",
+                {"reason": "cpu backend forced" if cpu_forced
+                 else "no TPU plugin importable",
+                 "flags_not_set": list(OVERLAP_XLA_FLAGS)},
+            )
+        else:
+            added = apply_overlap_xla_flags()
+            log.info(
+                "xla overlap flags",
+                {"added": added,
+                 "already_set": [f for f in OVERLAP_XLA_FLAGS
+                                 if f not in added]},
+            )
     if config.cpu:
         jax.config.update("jax_platforms", "cpu")
     if config.coordinator_address is not None and not _initialized:

@@ -449,6 +449,8 @@ class Trainer:
     def train(self) -> TrainState:
         cfg = self.config
         state, start_step = self.restore_or_init()
+        from ..parallel.sharding import describe
+
         log.info(
             "***** running training *****",
             {
@@ -460,6 +462,10 @@ class Trainer:
                 "accum_steps": cfg.gradient_accumulation_steps,
                 "total_optimizer_steps": self.total_steps,
                 "resumed_at_step": start_step,
+                # mesh + active FSDP execution mode (gspmd-default vs
+                # decomposed-prefetch) + per-leaf split-dim histogram: the
+                # run log records WHICH layout/schedule produced its numbers
+                **describe(self.ctx.mesh, cfg, state.params),
             },
         )
 

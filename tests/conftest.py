@@ -19,6 +19,11 @@ try:  # jax >= 0.5 spelling; XLA_FLAGS above covers driver environments
     jax.config.update("jax_num_cpu_devices", 8)
 except Exception:  # noqa: BLE001 - older jax: XLA_FLAGS alone applies
     pass
+# Sharding-invariant PRNG, matching runtime.init(): set ONCE for the whole
+# suite so a test that happens to run init() first cannot flip every later
+# test's random streams mid-process (see runtime/context.py for the
+# GSPMD-partitioned-threefry drift this fixes).
+jax.config.update("jax_threefry_partitionable", True)
 
 import pytest  # noqa: E402
 

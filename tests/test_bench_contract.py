@@ -117,3 +117,55 @@ def test_twoproc_record_within_band():
     assert last["value"] > 0
     assert 0.05 <= last["ratio_vs_single"] <= 3.0
     assert last["twoproc_psum_1mib_ms"] > 0
+
+
+@pytest.mark.slow
+def test_overlap_mode_contract():
+    """BENCH_MODE=overlap: one JSON line carrying the decomposed-FSDP
+    pair — bit-parity, HLO schedule evidence, memory live-range and the
+    step-time ratio (slow: a subprocess compiling two depth-2 train
+    steps; the committed record in bench_records/overlap_cpu_r8.jsonl is
+    the tier-1-visible evidence)."""
+    code, lines, out = run_bench({
+        "BENCH_MODE": "overlap", "BENCH_CPU_DEVICES": "4",
+        "BENCH_DEPTH": "4", "BENCH_SEQ": "16", "BENCH_BATCH": "1",
+        "BENCH_WARMUP": "1", "BENCH_STEPS": "2",
+    })
+    assert code == 0, out[-2000:]
+    assert len(lines) == 1, out[-2000:]
+    row = lines[0]
+    assert REQUIRED <= set(row)
+    assert row["metric"] == "fsdp_overlap_step_ratio_4L"
+    assert row["degenerate"] is False
+    assert row["value"] > 0
+    # the two execution paths trained the same model: tight parity
+    assert abs(row["loss_default"] - row["loss_overlap"]) < 1e-5
+    assert row["parity_max_abs_diff"] < 1e-6
+    # schedule evidence present and affirmative on the CPU partitioner
+    assert row["hlo_prefetch_gather_independent"] is True
+    assert row["hlo_bwd_regather_independent"] is True
+    assert row["hlo_bodies"]
+    if row.get("temp_overlap_mb") is not None:
+        assert row["live_range_ok"] is True
+
+
+def test_overlap_record_committed_and_affirmative():
+    """The committed round-8 CPU record must exist and actually show the
+    evidence the round claims: HLO schedule booleans true, parity at fp
+    tolerance, live range within two gathered layers."""
+    import json
+    from pathlib import Path
+
+    path = Path(__file__).resolve().parent.parent / "bench_records" / \
+        "overlap_cpu_r8.jsonl"
+    assert path.is_file(), "run BENCH_MODE=overlap to record the pair"
+    records = [json.loads(l) for l in path.read_text().splitlines() if l]
+    assert records
+    last = records[-1]
+    assert last["metric"].startswith("fsdp_overlap_step_ratio")
+    assert last["hlo_prefetch_gather_independent"] is True
+    assert last["hlo_bwd_regather_independent"] is True
+    assert last["parity_max_abs_diff"] < 1e-6
+    assert last["live_range_ok"] is True
+    # neutrality-or-better on the recorded pair (0.9 band -> vs_baseline)
+    assert last["vs_baseline"] >= 1.0

@@ -71,3 +71,38 @@ def test_fused_head_flag_reaches_model():
     assert task.model.fused_head is True
     with pytest.raises(ValueError, match="fused_head"):
         build("resnet18", parse_args(["--fused_head", "--model", "resnet18"]))
+
+
+def test_preempt_sync_steps_deprecation_warning():
+    """--preempt_sync_steps has been accepted-and-unused since the
+    host-sync-free hot loop; passing it must say so (once), omitting it
+    must stay silent and keep the historical default for config dumps."""
+    import warnings
+
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        cfg = parse_args(["--preempt_sync_steps", "4"])
+    assert cfg.preempt_sync_steps == 4
+    assert any(issubclass(w.category, DeprecationWarning)
+               and "preempt_sync_steps" in str(w.message) for w in rec)
+
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        cfg = parse_args([])
+    assert cfg.preempt_sync_steps == 8
+    assert not any(issubclass(w.category, DeprecationWarning) for w in rec)
+
+
+def test_fsdp_overlap_implies_fsdp():
+    # CLI path and direct-construction path both apply the implication
+    cfg = parse_args(["--fsdp_overlap", "--scan_layers"])
+    assert cfg.fsdp_overlap is True and cfg.fsdp is True
+    assert TrainingConfig(fsdp_overlap=True).fsdp is True
+    # and the implication survives a JSON round-trip unambiguously
+    assert TrainingConfig.from_json(cfg.to_json()).fsdp is True
+
+
+def test_xla_overlap_flags_parse():
+    cfg = parse_args(["--xla_overlap_flags"])
+    assert cfg.xla_overlap_flags is True
+    assert parse_args([]).xla_overlap_flags is False

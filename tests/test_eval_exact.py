@@ -186,7 +186,20 @@ class TestEvaluateExactContextParallel:
         """Exactly-once eval composed with context parallelism: holdout of
         37 on a data:2,seq:2 mesh (batch 8) — weights shard over data,
         sequences over seq, and the aggregate must still be the whole-set
-        statistic."""
+        statistic.
+
+        Tolerance rationale (round 8): this test was parked with a ~4e-4
+        relative "numeric drift" that root-caused to the PRNG, not to fp
+        reassociation — under the legacy non-partitionable threefry
+        lowering, GSPMD spatially partitioning the sharded jitted eval
+        drew DIFFERENT uniform bits than the eager reference leg (the
+        observed 4x-scaled values are shifted lane counters), so the two
+        legs scored different 15% MLM subsets and even the __denom__
+        values disagreed. With ``jax_threefry_partitionable=True``
+        (runtime.init + conftest) both legs draw identical masks and the
+        per-batch losses agree to the last printed digit; rel=1e-4 is
+        therefore pure headroom for cross-batch f32 aggregation order and
+        needed no widening."""
         from pytorch_ddp_template_tpu.data import SyntheticTokenDataset
 
         cfg = TrainingConfig(

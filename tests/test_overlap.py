@@ -1,0 +1,332 @@
+"""Decomposed FSDP (``--fsdp_overlap``, parallel/overlap.py): the
+prefetch-pipelined execution path must be numerically interchangeable with
+the GSPMD-default FSDP path (same stacked sharded weights, same math,
+different schedule), refuse configurations it cannot serve, and show the
+schedule signature in compiled HLO — collectives in the layer-loop bodies
+that do not consume the body's own compute."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from pytorch_ddp_template_tpu.config import TrainingConfig
+from pytorch_ddp_template_tpu.models import build
+from pytorch_ddp_template_tpu.parallel.overlap import (
+    UNSPLIT,
+    hlo_overlap_evidence,
+    make_layer_gather,
+    overlap_scan,
+    overlap_split_dims,
+    validate_overlap_mesh,
+)
+from pytorch_ddp_template_tpu.parallel.sharding import fsdp_reshard
+from pytorch_ddp_template_tpu.runtime import make_mesh
+
+TINY = ["gpt-tiny", "bert-tiny", "vit-tiny"]
+
+#: observed parity gap between the two FSDP execution paths is ~2e-9
+#: (layer-granular split is bit-exact; the custom-vjp recompute
+#: reassociates within-layer-split grads at the last f32 ulp); 1e-6 is
+#: pure headroom, far below any training-visible scale
+TOL = 1e-6
+
+
+def _max_abs_diff(a, b):
+    return max(
+        float(jnp.max(jnp.abs(x.astype(jnp.float32) - y.astype(jnp.float32))))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+# -- gather/scatter units --------------------------------------------------
+
+class TestLayerGather:
+    def test_split_dims_mirror_fsdp_reshard(self, devices):
+        mesh = make_mesh("data:-1")
+        n = mesh.shape["data"]
+        # layer-granular (L % n == 0), within-layer fallback, and unsplit
+        stacked = {
+            "deep": jnp.zeros((n, 4, 6)),       # L==n -> dim 0
+            "short": jnp.zeros((2, 3 * n, 6)),  # L=2 -> dim 1 (largest)
+            "odd": jnp.zeros((2, 3, 5)),        # nothing divides -> unsplit
+        }
+        dims = overlap_split_dims(stacked, n)
+        assert dims == {"deep": 0, "short": 1, "odd": UNSPLIT}
+        # the chooser must agree with where fsdp_reshard actually splits
+        placed = fsdp_reshard(stacked, mesh, prefer_dim=0)
+        assert placed["deep"].sharding.spec[0] == "data"
+        assert tuple(placed["short"].sharding.spec)[:2] == (None, "data")
+
+    @pytest.mark.parametrize("num_layers", [None, 2])
+    def test_gather_reproduces_slices_bit_exact(self, devices, num_layers):
+        mesh = make_mesh("data:-1")
+        n = mesh.shape["data"]
+        L = num_layers or n
+        rng = np.random.default_rng(0)
+        host = {
+            "w": rng.standard_normal((L, 3 * n, 4)).astype(np.float32),
+            "b": rng.standard_normal((L, 5)).astype(np.float32),
+        }
+        stacked = fsdp_reshard(jax.tree.map(jnp.asarray, host), mesh,
+                               prefer_dim=0)
+        gather, scatter = make_layer_gather(mesh, stacked, L)
+        jg = jax.jit(gather)
+        for k in range(L):
+            out = jg(stacked, jnp.asarray(k, jnp.int32))
+            for key in host:
+                np.testing.assert_array_equal(np.asarray(out[key]),
+                                              host[key][k])
+
+    def test_scatter_writes_only_layer_k(self, devices):
+        mesh = make_mesh("data:-1")
+        n = mesh.shape["data"]
+        L = n
+        stacked = fsdp_reshard(
+            {"w": jnp.zeros((L, 2 * n, 3))}, mesh, prefer_dim=0)
+        gather, scatter = make_layer_gather(mesh, stacked, L)
+        g = {"w": jnp.full((2 * n, 3), 7.0)}
+        out = np.asarray(jax.jit(scatter)(g, jnp.asarray(1, jnp.int32))["w"])
+        expect = np.zeros((L, 2 * n, 3), np.float32)
+        expect[1] = 7.0
+        np.testing.assert_array_equal(out, expect)
+
+
+class TestOverlapScan:
+    def test_matches_reference_values_and_grads(self, devices):
+        """Toy stack: y_{k+1} = tanh(y_k @ W_k). The pipelined scan (and
+        its hand-written backward) must agree with straight-line math in
+        both value and grads wrt weights AND input."""
+        mesh = make_mesh("data:-1")
+        n = mesh.shape["data"]
+        L, d = n, 6
+        rng = np.random.default_rng(1)
+        w_host = rng.standard_normal((L, d, d)).astype(np.float32) * 0.3
+        x_host = rng.standard_normal((4, d)).astype(np.float32)
+        stacked = fsdp_reshard({"w": jnp.asarray(w_host)}, mesh,
+                               prefer_dim=0)
+
+        def apply_one(w, y, k, extras):
+            return jnp.tanh(y @ w["w"])
+
+        def overlap_loss(stacked, x):
+            return jnp.sum(
+                overlap_scan(apply_one, stacked, x, (), mesh) ** 2)
+
+        def ref_loss(w, x):
+            y = x
+            for k in range(L):
+                y = jnp.tanh(y @ w[k])
+            return jnp.sum(y ** 2)
+
+        x = jnp.asarray(x_host)
+        lo, (gs, gx) = jax.jit(
+            jax.value_and_grad(overlap_loss, argnums=(0, 1)))(stacked, x)
+        lr, (gw_ref, gx_ref) = jax.jit(
+            jax.value_and_grad(ref_loss, argnums=(0, 1)))(
+            jnp.asarray(w_host), x)
+        np.testing.assert_allclose(float(lo), float(lr), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(gs["w"]), np.asarray(gw_ref),
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_ref),
+                                   atol=1e-5)
+
+    def test_single_layer_stack(self, devices):
+        mesh = make_mesh("data:-1")
+        stacked = {"w": jnp.eye(4)[None]}  # L=1, unsplit
+        out = jax.jit(lambda s, x: overlap_scan(
+            lambda w, y, k, e: y @ w["w"], s, x, (), mesh))(
+            stacked, jnp.ones((2, 4)))
+        np.testing.assert_array_equal(np.asarray(out), np.ones((2, 4)))
+
+
+# -- model-path parity -----------------------------------------------------
+
+def _pair(name):
+    cfg_d = TrainingConfig(model=name, dataset_size=32, scan_layers=True,
+                           fsdp=True)
+    cfg_o = TrainingConfig(model=name, dataset_size=32, scan_layers=True,
+                           fsdp_overlap=True)
+    mesh = make_mesh("data:-1")
+    task_d, ds = build(name, cfg_d, mesh=mesh)
+    task_o, _ = build(name, cfg_o, mesh=mesh)
+    batch = {k: jax.device_put(np.asarray(v),
+                               NamedSharding(mesh, P("data")))
+             for k, v in ds.batch(np.arange(8)).items()}
+    return task_d, task_o, batch, mesh
+
+
+@pytest.mark.slow  # ~17s of model jits; the gather/scan units above are
+#                    the tier-1 tripwire, this is the model-level pin
+def test_gpt_tiny_loss_and_grad_parity(devices):
+    """Within-layer-split regime (2 layers on 8 devices): loss and every
+    grad leaf agree between the GSPMD-default and decomposed paths."""
+    task_d, task_o, batch, mesh = _pair("gpt-tiny")
+    assert task_o.model.fsdp_overlap and task_o.model.mesh is mesh
+    key = jax.random.PRNGKey(0)
+    params, _ = task_d.init(key, batch)
+    params = fsdp_reshard(nn.meta.unbox(params), mesh, prefer_dim=0)
+
+    def loss_of(task):
+        def f(p):
+            loss, _, _ = task.loss(p, {}, batch, None, train=False)
+            return loss
+        return jax.jit(jax.value_and_grad(f))
+
+    ld, gd = loss_of(task_d)(params)
+    lo, go = loss_of(task_o)(params)
+    np.testing.assert_allclose(float(ld), float(lo), atol=TOL)
+    assert _max_abs_diff(gd, go) < TOL
+
+
+def test_refusals_fail_with_intent(devices):
+    mesh = make_mesh("data:-1")
+    with pytest.raises(ValueError, match="needs --scan_layers"):
+        build("gpt-tiny", TrainingConfig(model="gpt-tiny",
+                                         fsdp_overlap=True), mesh=mesh)
+    with pytest.raises(ValueError, match="MoE"):
+        build("gpt-moe-tiny",
+              TrainingConfig(model="gpt-moe-tiny", scan_layers=True,
+                             fsdp_overlap=True), mesh=mesh)
+    with pytest.raises(ValueError, match="GPipe pipeline"):
+        build("gpt-pipe-tiny",
+              TrainingConfig(model="gpt-pipe-tiny", scan_layers=True,
+                             fsdp_overlap=True), mesh=mesh)
+    with pytest.raises(ValueError, match="no transformer layer stack"):
+        build("mlp", TrainingConfig(model="mlp", scan_layers=True,
+                                    fsdp_overlap=True), mesh=mesh)
+    with pytest.raises(ValueError, match="data-axis FSDP only"):
+        validate_overlap_mesh(make_mesh("data:4,model:2"))
+    with pytest.raises(ValueError, match="mesh"):
+        validate_overlap_mesh(None)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", TINY)
+def test_engine_step_parity(name, devices):
+    """One full jitted optimizer step per family: the decomposed path
+    updates every weight to within TOL of the GSPMD-default path (slow:
+    two train-step compiles per family). Dropout is cloned OFF (bert-tiny
+    defaults 0.1): with dropout active the two paths draw per-layer
+    streams differently by design (overlap folds the layer index where
+    nn.scan splits) — statistically equivalent, documented in README, and
+    not the math this test pins."""
+    from pytorch_ddp_template_tpu.parallel.sharding import shard_tree
+    from pytorch_ddp_template_tpu.train.engine import (
+        TrainState, make_optimizer, make_train_step,
+    )
+
+    task_d, task_o, batch, mesh = _pair(name)
+    task_d.model = task_d.model.clone(dropout_rate=0.0)
+    task_o.model = task_o.model.clone(dropout_rate=0.0)
+    cfg = TrainingConfig(model=name, warmup_steps=0)
+    key = jax.random.PRNGKey(0)
+    states, metrics = {}, {}
+    for tag, task in (("default", task_d), ("overlap", task_o)):
+        params, extra = task.init(key, batch)
+        tx, schedule = make_optimizer(cfg, total_steps=10)
+        state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                           extra_vars=extra, opt_state=tx.init(params),
+                           rng=jax.random.clone(key))
+        state = shard_tree(state, mesh)
+        state = state.replace(
+            params=fsdp_reshard(state.params, mesh, prefer_dim=0),
+            opt_state=fsdp_reshard(state.opt_state, mesh, prefer_dim=0),
+        )
+        step = make_train_step(task, tx, schedule)
+        states[tag], metrics[tag] = step(state, batch)
+    np.testing.assert_allclose(np.asarray(metrics["default"]["loss"]),
+                               np.asarray(metrics["overlap"]["loss"]),
+                               atol=TOL)
+    assert _max_abs_diff(states["default"].params,
+                         states["overlap"].params) < TOL
+
+
+@pytest.mark.slow
+def test_parity_against_unrolled_fsdp(devices):
+    """Scan-off cross-check: the decomposed path agrees with the plain
+    UNROLLED FSDP model too (through the unrolled->scanned init
+    interchangeability pinned by test_scan_layers)."""
+    mesh = make_mesh("data:-1")
+    cfg_u = TrainingConfig(model="gpt-tiny", dataset_size=32, fsdp=True)
+    task_u, ds = build("gpt-tiny", cfg_u, mesh=mesh)
+    task_d, task_o, batch, _ = _pair("gpt-tiny")
+    key = jax.random.PRNGKey(0)
+    params_u, _ = task_u.init(key, batch)
+    params_s, _ = task_o.init(key, batch)
+    pu = fsdp_reshard(nn.meta.unbox(params_u), mesh)
+    ps = fsdp_reshard(nn.meta.unbox(params_s), mesh, prefer_dim=0)
+
+    def loss_of(task, p):
+        return float(jax.jit(
+            lambda p: task.loss(p, {}, batch, None, train=False)[0])(p))
+
+    assert abs(loss_of(task_u, pu) - loss_of(task_o, ps)) < TOL
+
+
+@pytest.mark.slow
+def test_hlo_evidence_and_memory(devices):
+    """Depth-8 (layer-granular) compiled train step: the loop bodies must
+    show compute-independent collectives (the prefetch/re-gather), and
+    the decomposed path's temp memory must stay within ~2 gathered layers
+    of the default path's (the live-range bound; in practice it is far
+    BELOW default, since the custom-vjp backward never stacks gathered
+    weights as residuals)."""
+    from pytorch_ddp_template_tpu.models.gpt import CausalLmTask, GptDecoder
+    from pytorch_ddp_template_tpu.parallel.sharding import shard_tree
+    from pytorch_ddp_template_tpu.train.engine import (
+        TrainState, make_optimizer, make_train_step,
+    )
+
+    mesh = make_mesh("data:-1")
+    vocab, seq, depth = 128, 32, 8
+    ids = np.random.default_rng(0).integers(0, vocab, (8, seq))
+    batch = {"input_ids": jax.device_put(
+        np.asarray(ids, np.int32), NamedSharding(mesh, P("data")))}
+    cfg = TrainingConfig(warmup_steps=0)
+    key = jax.random.PRNGKey(0)
+
+    compiled = {}
+    layer_bytes = None
+    for overlap in (False, True):
+        model = GptDecoder(vocab_size=vocab, max_len=seq, num_layers=depth,
+                           num_heads=2, head_dim=16, mlp_dim=64,
+                           scan_layers=True, fsdp_overlap=overlap,
+                           mesh=mesh if overlap else None)
+        task = CausalLmTask(model)
+        params, extra = task.init(key, batch)
+        tx, schedule = make_optimizer(cfg, total_steps=10)
+        state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                           extra_vars=extra, opt_state=tx.init(params),
+                           rng=jax.random.clone(key))
+        state = shard_tree(state, mesh)
+        state = state.replace(
+            params=fsdp_reshard(state.params, mesh, prefer_dim=0),
+            opt_state=fsdp_reshard(state.opt_state, mesh, prefer_dim=0),
+        )
+        if layer_bytes is None:
+            stacked = state.params["decoder"]["layers"]
+            layer_bytes = sum(
+                l.size * l.dtype.itemsize for l in jax.tree.leaves(stacked)
+            ) // depth
+        compiled[overlap] = make_train_step(task, tx, schedule).lower(
+            state, batch).compile()
+
+    ev = hlo_overlap_evidence(compiled[True].as_text())
+    assert ev["prefetch_gather_independent"], ev
+    assert ev["bwd_regather_independent"], ev
+    # every loop body carries collectives; the forward one is ALL
+    # independent (pure prefetch)
+    assert any(r["compute_dependent_collectives"] == 0
+               for r in ev["bodies"]), ev
+    try:
+        t_default = compiled[False].memory_analysis().temp_size_in_bytes
+        t_overlap = compiled[True].memory_analysis().temp_size_in_bytes
+    except Exception:  # pragma: no cover - backend without the API
+        return
+    assert t_overlap <= t_default + 2.5 * layer_bytes, (
+        f"gathered live range exceeded two layers: overlap temp "
+        f"{t_overlap} vs default {t_default} + 2.5*{layer_bytes}"
+    )
