@@ -31,6 +31,25 @@ from ..utils import get_logger
 log = get_logger(__name__)
 
 
+def _split_residual(state: Any) -> tuple[Any, Any]:
+    """``(body, residual)`` for a state that may carry ``comm_residual``.
+
+    The error-feedback residual (``--grad_error_feedback``,
+    ``parallel/compress.py``) is stored as its OWN checkpoint item, and
+    the state body is serialised as a field dict *without* the key: the
+    stored layout is byte-identical whether the field exists, is None, or
+    holds a tree — so pre-residual checkpoints restore into the new
+    ``TrainState`` and residual-carrying checkpoints restore into runs
+    that turned error feedback off (the item is simply never requested).
+    Non-dataclass states (raw pytrees from tools) pass through untouched.
+    """
+    if not hasattr(state, "comm_residual"):
+        return state, None
+    body = {f.name: getattr(state, f.name)
+            for f in dataclasses.fields(state) if f.name != "comm_residual"}
+    return body, state.comm_residual
+
+
 class CheckpointManager:
     """Save/restore ``(state_pytree, config)`` at step-numbered dirs."""
 
@@ -72,14 +91,16 @@ class CheckpointManager:
         # field but determines the eval tail-holdout split point; record
         # it so --eval_only can verify the split is reproducible
         payload["_train_batch_size"] = config.train_batch_size
-        self._mngr.save(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardSave(state),
-                config=ocp.args.JsonSave(payload),
-            ),
-            force=force,
-        )
+        body, residual = _split_residual(state)
+        items: dict[str, Any] = {
+            "state": ocp.args.StandardSave(body),
+            "config": ocp.args.JsonSave(payload),
+        }
+        if residual is not None:
+            # separate item so runs without error feedback never see it
+            # (and pre-residual checkpoints simply lack it)
+            items["residual"] = ocp.args.StandardSave(residual)
+        self._mngr.save(step, args=ocp.args.Composite(**items), force=force)
         log.info("checkpoint saved", {"step": step, "dir": str(self.directory)})
 
     def wait(self) -> None:
@@ -136,13 +157,38 @@ class CheckpointManager:
             step = self.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        body_tmpl, res_tmpl = _split_residual(template_state)
         restored = self._mngr.restore(
             step,
             args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(template_state),
+                state=ocp.args.StandardRestore(body_tmpl),
                 config=ocp.args.JsonRestore(),
             ),
         )
+        state = restored["state"]
+        if body_tmpl is not template_state:
+            # field-dict body back into the dataclass; then the residual:
+            # restore it when the checkpoint carries a compatible one,
+            # else keep the template's zero init (pre-residual checkpoint,
+            # or one written with different comm settings/topology) —
+            # error feedback restarts cleanly rather than crashing the run
+            state = template_state.replace(**state)
+            if res_tmpl is not None:
+                try:
+                    r = self._mngr.restore(
+                        step,
+                        args=ocp.args.Composite(
+                            residual=ocp.args.StandardRestore(res_tmpl)),
+                    )
+                    state = state.replace(comm_residual=r["residual"])
+                except Exception as exc:  # noqa: BLE001 - best-effort state
+                    log.warning(
+                        "checkpoint has no compatible comm_residual — "
+                        "error-feedback residual zero-initialised "
+                        "(expected for pre-residual checkpoints or after "
+                        "changing --grad_comm/topology)",
+                        {"step": step, "reason": f"{type(exc).__name__}"},
+                    )
         cfg = restored["config"]
         from .. import native
 
@@ -155,7 +201,7 @@ class CheckpointManager:
                 saved_native, native.available(),
             )
         log.info("checkpoint restored", {"step": step})
-        return restored["state"], cfg
+        return state, cfg
 
     def close(self) -> None:
         self._mngr.close()

@@ -81,6 +81,25 @@ class TrainingConfig:
     #                                  flag pack (async collectives overlap
     #                                  with compute) before backend init;
     #                                  runtime/context.py logs what was set
+    ddp_overlap: bool = False  # per-layer overlapped grad reduce for pure
+    #                            DDP (parallel/compress.py): the scanned
+    #                            stack's backward issues each layer's
+    #                            cross-replica grad reduce inside its own
+    #                            reverse-scan iteration (the TPU-native
+    #                            form of DDP bucketing). Needs
+    #                            --scan_layers; replicated params on
+    #                            data-only meshes; FSDP/MoE/pipe refused
+    grad_comm: str = "fp32"  # wire precision of the per-layer grad reduce
+    #                          under --ddp_overlap: fp32 | bf16 | int8
+    #                          (chunked symmetric quantization with
+    #                          stochastic rounding; halves/quarters grad
+    #                          bytes on the wire)
+    grad_error_feedback: bool = False  # carry a per-replica compression-
+    #                                    error residual in TrainState and
+    #                                    re-inject it next step (1-bit-SGD
+    #                                    lineage): the quantization error
+    #                                    telescopes instead of random-
+    #                                    walking. Needs a lossy --grad_comm
     remat: bool = False  # rematerialise blocks (peak-memory for FLOPs trade;
     #                      long-context entries default it on regardless)
     scan_layers: bool = False  # drive the transformer block stack as ONE
@@ -128,6 +147,41 @@ class TrainingConfig:
         # the flag implies it (the same way --fsdp subsumes --zero1)
         if self.fsdp_overlap:
             self.fsdp = True
+        if self.grad_comm not in ("fp32", "bf16", "int8"):
+            raise ValueError(
+                f"unknown --grad_comm {self.grad_comm!r}; expected "
+                "fp32 | bf16 | int8"
+            )
+        if self.ddp_overlap and self.fsdp:
+            # mutually exclusive by construction: --ddp_overlap's reduce
+            # regions assume replicated params, --fsdp shards them (its
+            # own overlapped execution is --fsdp_overlap)
+            raise ValueError(
+                "--ddp_overlap assumes replicated params and cannot "
+                "compose with --fsdp/--fsdp_overlap (whose grads are "
+                "reduce-scattered by layout); pick one execution mode"
+            )
+        if self.grad_comm != "fp32" and not self.ddp_overlap:
+            raise ValueError(
+                f"--grad_comm {self.grad_comm} compresses the per-layer "
+                "grad reduce that only exists under --ddp_overlap (the "
+                "GSPMD-implicit reduce is fp32-or-nothing); pass "
+                "--ddp_overlap too"
+            )
+        if self.grad_error_feedback and self.grad_comm == "fp32":
+            raise ValueError(
+                "--grad_error_feedback compensates lossy gradient "
+                "compression; with --grad_comm fp32 there is no error to "
+                "feed back — pass --grad_comm bf16|int8 or drop the flag"
+            )
+        if self.grad_error_feedback and self.gradient_accumulation_steps > 1:
+            raise ValueError(
+                "--grad_error_feedback does not compose with "
+                "--gradient_accumulation_steps > 1 yet: each microbatch "
+                "would need the previous one's residual sequentially, but "
+                "the accumulation scan reduces per microbatch in "
+                "parallel semantics; drop one of the two"
+            )
 
     @property
     def data_axis_size(self) -> int:
@@ -248,6 +302,37 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "forced (unknown flags are FATAL to other "
                         "backends); the runtime logs exactly what was set "
                         "or why it was skipped.")
+    p.add_argument("--ddp_overlap", action="store_true",
+                   help="Per-layer overlapped gradient reduce for pure "
+                        "DDP (parallel/compress.py): the scanned stack's "
+                        "hand-written backward issues each layer's cross-"
+                        "replica grad reduce inside its own reverse-scan "
+                        "iteration, so the reduce drains under the next "
+                        "layer's backward compute — PyTorch DDP's bucketed-"
+                        "allreduce overlap, TPU-native (one bucket per "
+                        "layer, pinned by construction). Requires "
+                        "--scan_layers; replicated-param data-only meshes; "
+                        "FSDP/MoE/pipe entries refused.")
+    p.add_argument("--grad_comm", type=str, default="fp32",
+                   choices=["fp32", "bf16", "int8"],
+                   help="Wire precision of the --ddp_overlap per-layer "
+                        "grad reduce: quantized reduce-scatter -> fp32 "
+                        "dequant-sum -> re-quantized all-gather. bf16 "
+                        "halves and int8 quarters gradient wire bytes "
+                        "(chunked symmetric per-bucket quantization with "
+                        "stochastic rounding). Embedding/head grads "
+                        "outside the scanned stack keep the GSPMD fp32 "
+                        "reduce; startup logs record both byte totals.")
+    p.add_argument("--grad_error_feedback", action="store_true",
+                   help="Keep each replica's gradient-compression error in "
+                        "a TrainState residual and re-inject it next step "
+                        "(1-bit-SGD lineage error feedback): the applied-"
+                        "update sum tracks the true-gradient sum to within "
+                        "one step's residual. Needs a lossy --grad_comm. "
+                        "Residuals checkpoint best-effort: restoring onto "
+                        "a different topology or from a pre-residual "
+                        "checkpoint zero-initialises them (fresh runs "
+                        "recommended when changing comm settings).")
     p.add_argument("--fused_head", action="store_true",
                    help="Compute the LM head blockwise over the vocab "
                         "(ops/lm_head.py): the (B,T,V) logits tensor never "

@@ -47,6 +47,12 @@ class BertEncoder(nn.Module):
     # decomposed FSDP (--fsdp_overlap, parallel/overlap.py): prefetched
     # per-layer weight gathers + overlapped grad drain; needs scan_layers
     fsdp_overlap: bool = False
+    # compressed DDP (--ddp_overlap, parallel/compress.py): per-layer
+    # grad reduce inside the backward scan, in grad_comm wire precision,
+    # optional error-feedback residual; needs scan_layers
+    ddp_overlap: bool = False
+    grad_comm: str = "fp32"
+    grad_error_feedback: bool = False
     # blockwise tied MLM head (ops/lm_head.py): return the transformed
     # head hidden states; the task applies table+bias vocab-block-wise,
     # so the (B, T, V) logits tensor never exists
@@ -82,6 +88,9 @@ class BertEncoder(nn.Module):
             remat=self.remat,
             scan_layers=self.scan_layers,
             fsdp_overlap=self.fsdp_overlap,
+            ddp_overlap=self.ddp_overlap,
+            grad_comm=self.grad_comm,
+            grad_error_feedback=self.grad_error_feedback,
             name="encoder",
         )
         self.mlm_ln = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")

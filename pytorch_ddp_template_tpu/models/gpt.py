@@ -47,6 +47,12 @@ class GptDecoder(nn.Module):
     # decomposed FSDP (--fsdp_overlap, parallel/overlap.py): prefetched
     # per-layer weight gathers + overlapped grad drain; needs scan_layers
     fsdp_overlap: bool = False
+    # compressed DDP (--ddp_overlap, parallel/compress.py): per-layer
+    # grad reduce inside the backward scan, in grad_comm wire precision,
+    # optional error-feedback residual; needs scan_layers
+    ddp_overlap: bool = False
+    grad_comm: str = "fp32"
+    grad_error_feedback: bool = False
     # blockwise tied head (ops/lm_head.py): the model returns final hidden
     # states and the task computes cross-entropy vocab-block-wise — the
     # (B, T, V) logits tensor never exists. The memory enabler for the
@@ -85,6 +91,9 @@ class GptDecoder(nn.Module):
             moe_experts=self.moe_experts,
             scan_layers=self.scan_layers,
             fsdp_overlap=self.fsdp_overlap,
+            ddp_overlap=self.ddp_overlap,
+            grad_comm=self.grad_comm,
+            grad_error_feedback=self.grad_error_feedback,
             name="decoder",
         )(x, train=train)
         x = nn.LayerNorm(dtype=jnp.float32, name="final_ln")(x)

@@ -116,6 +116,37 @@ def build(name: str, config: TrainingConfig, mesh=None) -> tuple[Task, Dataset]:
             mesh = make_mesh(config.mesh, jax.devices())
         validate_overlap_mesh(mesh)  # fail fast, before any tracing
         task.model = task.model.clone(fsdp_overlap=True, mesh=mesh)
+    if config.ddp_overlap:
+        if not config.scan_layers:
+            raise ValueError(
+                "--ddp_overlap needs --scan_layers: the stacked "
+                "(num_layers, ...) weight layout IS the unit of the "
+                "per-layer reduce schedule (and keeps checkpoints in the "
+                "scanned layout); pass both flags"
+            )
+        if not hasattr(task.model, "ddp_overlap"):
+            raise ValueError(
+                f"--ddp_overlap: model {name!r} "
+                f"({type(task.model).__name__}) has no compressed-DDP "
+                "execution path (transformer families only)"
+            )
+        if getattr(task.model, "moe_experts", 0):
+            raise ValueError(
+                "--ddp_overlap does not compose with MoE entries yet "
+                "(sown load-balance losses and expert dispatch need "
+                "in-region handling); drop one of the two"
+            )
+        from ..parallel.compress import validate_ddp_mesh
+        from ..runtime import make_mesh
+
+        import jax
+
+        if mesh is None:
+            mesh = make_mesh(config.mesh, jax.devices())
+        validate_ddp_mesh(mesh)  # fail fast, before any tracing
+        task.model = task.model.clone(
+            ddp_overlap=True, mesh=mesh, grad_comm=config.grad_comm,
+            grad_error_feedback=config.grad_error_feedback)
     if config.data_dir:
         from ..data.filestore import MemmapDataset
 

@@ -225,6 +225,142 @@ class TransformerEncoder(nn.Module):
     # a data-only mesh; init still runs through nn.scan so the param
     # layout, checkpoints and Task.init interchangeability are unchanged.
     fsdp_overlap: bool = False
+    # compressed-DDP execution (--ddp_overlap, parallel/compress.py):
+    # replicated params, per-layer cross-replica grad reduce issued
+    # inside the backward scan iteration in grad_comm wire precision,
+    # optional error-feedback residual (collection "comm_residual",
+    # threaded from TrainState by the engine). Same scan_layers/data-only
+    # requirements as fsdp_overlap; param layout unchanged.
+    ddp_overlap: bool = False
+    grad_comm: str = "fp32"
+    grad_error_feedback: bool = False
+
+    @property
+    def _ef_active(self) -> bool:
+        return (self.ddp_overlap and self.grad_error_feedback
+                and self.grad_comm != "fp32")
+
+    def _declare_comm_residual(self, src_key: str) -> None:
+        """Create the zero error-feedback residual as a ``comm_residual``
+        collection variable during init, shaped from the just-created
+        block params under ``src_key`` (``layer_0`` in the unrolled twin
+        Task.init drives, the stacked subtree in a direct scanned init).
+        Declared at the encoder level in both twins, so the collection
+        path — which the engine round-trips through TrainState — is
+        layout-independent."""
+        from ..parallel.compress import init_residual
+        from ..runtime.context import DATA_AXIS
+
+        if self.mesh is None:
+            raise ValueError(
+                "--grad_error_feedback needs the device mesh at init to "
+                "size the per-replica residual (models/registry.py threads "
+                "it; pass mesh= when building directly)"
+            )
+        src = nn.meta.unbox(self.scope.get_variable("params", src_key))
+        if src is None:
+            raise ValueError(
+                f"comm_residual init found no {src_key!r} block params"
+            )
+        stacked_shapes = jax.tree.map(
+            lambda p: (jax.ShapeDtypeStruct(p.shape, p.dtype)
+                       if src_key == SCAN_LAYER_AXIS
+                       else jax.ShapeDtypeStruct((self.num_layers,) + p.shape,
+                                                 p.dtype)),
+            src,
+        )
+        data_size = self.mesh.shape.get(DATA_AXIS, 1)
+        self.variable("comm_residual", "residual",
+                      lambda: init_residual(stacked_shapes, data_size))
+
+    def _ddp_forward(self, block_cls, x, mask, train):
+        """Drive the stacked block via ``parallel.compress.ddp_overlap_scan``:
+        same replicated weights, same math, but each layer's grad reduce
+        happens inside its own backward iteration in ``grad_comm`` wire
+        precision. Numerics match the nn.scan path to reduction
+        reassociation under fp32 comms and dropout-free training; with
+        dropout active each replica folds the layer index and its data-
+        axis coordinate into the stream (statistically equivalent, not
+        bit-interchangeable — documented in README)."""
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.compress import ddp_overlap_scan, validate_ddp_mesh
+        from ..runtime.context import DATA_AXIS
+
+        if self.moe_experts:
+            raise ValueError(
+                "--ddp_overlap does not compose with MoE blocks yet (the "
+                "sown load-balance losses and expert dispatch need "
+                "in-region handling); drop one of the two"
+            )
+        validate_ddp_mesh(self.mesh)
+        stacked = nn.meta.unbox(
+            self.scope.get_variable("params", SCAN_LAYER_AXIS))
+        if stacked is None:
+            raise ValueError(
+                "ddp_overlap apply found no stacked "
+                f"'{SCAN_LAYER_AXIS}' params — was the model initialised "
+                "with scan_layers?"
+            )
+        block = block_cls(
+            self.num_heads, self.head_dim, self.mlp_dim, self.dtype,
+            self.dropout_rate, self.pre_norm, self.attn_impl, self.mesh,
+            self.causal, moe_experts=self.moe_experts,
+            parent=None, name=SCAN_LAYER_AXIS,
+        )
+        lossy = self.grad_comm != "fp32"
+        base_rng = None
+        if train and self.has_rng("dropout") and (self.dropout_rate or lossy):
+            base_rng = self.make_rng("dropout")
+        if train and lossy and base_rng is None:
+            raise ValueError(
+                f"--grad_comm {self.grad_comm} training needs an rng "
+                "stream for stochastic rounding; apply with "
+                "rngs={'dropout': key} (the engine always passes one)"
+            )
+        drop_rng = base_rng if (train and self.dropout_rate) else None
+        # decorrelate the stochastic-rounding stream from every per-layer
+        # dropout fold (which use indices 0..num_layers-1)
+        comm_rng = (jax.random.fold_in(base_rng, self.num_layers + 1)
+                    if (train and lossy) else None)
+        residual = None
+        if train and self._ef_active:
+            if not self.has_variable("comm_residual", "residual"):
+                raise ValueError(
+                    "--grad_error_feedback apply found no comm_residual "
+                    "state — the engine threads TrainState.comm_residual "
+                    "in as the 'comm_residual' collection (fresh inits "
+                    "create it; see train/engine.py)"
+                )
+            residual = self.scope.get_variable("comm_residual", "residual")
+
+        def apply_one(w, y, k, extras):
+            m, r = extras
+            rngs = None
+            if r is not None:
+                # per-layer, per-replica dropout stream: apply_one runs
+                # inside the shard_map region, so the axis fold gives
+                # each replica its own mask over its own batch shard
+                rr = jax.random.fold_in(jax.random.fold_in(r, k),
+                                        jax.lax.axis_index(DATA_AXIS))
+                rngs = {"dropout": rr}
+            # positional train: the remat wrapper pins it static via
+            # static_argnums=(3,) (self counts as argnum 0)
+            if self.remat:
+                return block.apply({"params": w}, y, m, train, rngs=rngs)
+            return block.apply({"params": w}, y, m, train=train, rngs=rngs)
+
+        extras = (mask, drop_rng)
+        extras_specs = (None if mask is None else P(DATA_AXIS),
+                        None if drop_rng is None else P())
+        return ddp_overlap_scan(
+            apply_one, stacked, x, extras, extras_specs, self.mesh,
+            # eval never runs the backward, so the wire mode is moot —
+            # fp32 keeps the rng-free eval path from demanding an rng
+            # (and anyone differentiating an eval-mode loss gets exact
+            # grads, which is what a probe wants)
+            grad_comm=self.grad_comm if train else "fp32",
+            residual=residual, comm_rng=comm_rng)
 
     def _overlap_forward(self, block_cls, x, mask, train):
         """Drive the stacked block via ``parallel.overlap.overlap_scan``
@@ -282,8 +418,11 @@ class TransformerEncoder(nn.Module):
         if self.remat:
             block_cls = nn.remat(EncoderBlock, static_argnums=(3,))
         if self.scan_layers:
-            if self.fsdp_overlap and not self.is_initializing():
-                return self._overlap_forward(block_cls, x, mask, train)
+            if not self.is_initializing():
+                if self.fsdp_overlap:
+                    return self._overlap_forward(block_cls, x, mask, train)
+                if self.ddp_overlap:
+                    return self._ddp_forward(block_cls, x, mask, train)
             block = block_cls(
                 self.num_heads, self.head_dim, self.mlp_dim, self.dtype,
                 self.dropout_rate, self.pre_norm, self.attn_impl, self.mesh,
@@ -311,6 +450,8 @@ class TransformerEncoder(nn.Module):
                 length=self.num_layers,
                 metadata_params={nn.meta.PARTITION_NAME: SCAN_LAYER_AXIS},
             )(block, x, None)
+            if self._ef_active and self.is_initializing():
+                self._declare_comm_residual(SCAN_LAYER_AXIS)
             return x
         for layer in range(self.num_layers):
             block = block_cls(
@@ -321,4 +462,9 @@ class TransformerEncoder(nn.Module):
             )
             x = block(x, mask, train) if self.remat else block(
                 x, mask, train=train)
+        if self._ef_active and self.is_initializing():
+            # the unrolled twin drives scan-layers init (Task.init's
+            # bit-interchangeable restack); declare the residual here too
+            # so the restacked variables carry it at the same path
+            self._declare_comm_residual("layer_0")
         return x

@@ -39,9 +39,15 @@ class VisionTransformer(nn.Module):
     scan_layers: bool = False
     # decomposed FSDP (--fsdp_overlap, parallel/overlap.py): prefetched
     # per-layer weight gathers + overlapped grad drain; needs scan_layers.
-    # The mesh rides along only for this mode (ViT has no context-parallel
-    # attention to thread it for otherwise).
+    # The mesh rides along only for the overlap modes (ViT has no
+    # context-parallel attention to thread it for otherwise).
     fsdp_overlap: bool = False
+    # compressed DDP (--ddp_overlap, parallel/compress.py): per-layer
+    # grad reduce inside the backward scan, in grad_comm wire precision,
+    # optional error-feedback residual; needs scan_layers
+    ddp_overlap: bool = False
+    grad_comm: str = "fp32"
+    grad_error_feedback: bool = False
     mesh: Any = None
 
     @nn.compact
@@ -90,6 +96,9 @@ class VisionTransformer(nn.Module):
             remat=self.remat,
             scan_layers=self.scan_layers,
             fsdp_overlap=self.fsdp_overlap,
+            ddp_overlap=self.ddp_overlap,
+            grad_comm=self.grad_comm,
+            grad_error_feedback=self.grad_error_feedback,
             name="encoder",
         )(x, train=train)
 

@@ -11,6 +11,13 @@ parallelism gets a minimal GPipe mechanism over the ``pipe`` axis
 over the ``expert`` axis (``expert.py``).
 """
 
+from .compress import (
+    compressed_allreduce,
+    ddp_overlap_scan,
+    hlo_comms_evidence,
+    validate_ddp_mesh,
+    wire_bytes_per_step,
+)
 from .expert import expert_apply, stack_expert_params
 from .overlap import hlo_overlap_evidence, overlap_scan, validate_overlap_mesh
 from .pipeline import pipeline_apply, stack_stage_params
@@ -30,8 +37,13 @@ from .ulysses import ulysses_attention
 __all__ = [
     "DEFAULT_RULES",
     "active_rules",
+    "compressed_allreduce",
+    "ddp_overlap_scan",
     "describe",
     "expert_apply",
+    "hlo_comms_evidence",
+    "validate_ddp_mesh",
+    "wire_bytes_per_step",
     "fsdp_reshard",
     "fsdp_split_dim",
     "hlo_overlap_evidence",

@@ -1,0 +1,509 @@
+"""Compressed, backward-overlapped gradient collectives for pure DDP
+(``--ddp_overlap`` + ``--grad_comm {fp32,bf16,int8}`` +
+``--grad_error_feedback``).
+
+Under plain replicated-param DDP the cross-replica gradient mean is left
+entirely to GSPMD: the batch is sharded over ``data``, params are
+replicated, and XLA inserts one fp32 all-reduce per gradient leaf after
+backward (train/engine.py's "NCCL-DDP replacement"). PyTorch DDP's
+signature perf feature — bucketed gradient all-reduce *overlapped with
+backward compute* (Li et al., VLDB 2020) — and the 1-bit-SGD lineage of
+*compressed* gradient exchange with error feedback (Seide et al., 2014)
+both live below that abstraction. This module rebuilds them TPU-natively
+on the round-8 decomposed-scan machinery (``parallel/overlap.py``):
+
+- :func:`ddp_overlap_scan` drives the scanned transformer stack with a
+  hand-written ``custom_vjp`` whose reverse ``lax.scan`` computes each
+  layer's *per-replica* gradients inside a ``shard_map`` region over
+  ``data`` and issues that layer's cross-replica reduce **inside the
+  iteration** — layer k's reduce is dataflow-independent of layer k-1's
+  backward compute, so the latency-hiding scheduler can drain it under
+  the next layer's matmuls: the TPU-native form of DDP bucketing (one
+  bucket per layer, pinned by construction rather than by hook order).
+
+- The explicit reduce is where compression becomes possible at all:
+  GSPMD's implicit psum is fp32-or-nothing, but a manual reduce can ship
+  quantized bytes. ``grad_comm`` selects the wire format, executed as a
+  quantized all-to-all (the reduce-scatter phase: each replica owns 1/n
+  of every layer's flattened grads), an fp32 dequant-sum on the owner,
+  and a re-quantized all-gather — bf16 halves and int8 quarters the
+  bytes on the wire (:func:`wire_bytes_per_step`). int8 uses chunked
+  symmetric per-bucket quantization (:data:`CHUNK`-wide buckets, scale =
+  absmax/127) with stochastic rounding; bf16 uses stochastic
+  mantissa-rounding. Both phases round stochastically, so each exchange
+  is unbiased.
+
+- ``--grad_error_feedback`` carries a per-replica residual tree
+  (``TrainState.comm_residual``, leaves ``(L, data_size, padded)``
+  sharded over ``data``): each replica adds its residual to its local
+  grads before quantizing and keeps back exactly the error both
+  quantization phases introduced, so the compression error telescopes —
+  the sum of applied updates tracks the sum of true gradients to within
+  one step's residual instead of a random walk. The residual rides the
+  custom_vjp as a primal input whose *cotangent slot carries the updated
+  residual out of the backward pass* (backward-only state cannot surface
+  through any other in-jit channel); ``train/engine.py`` differentiates
+  w.r.t. it and writes the cotangent back into ``TrainState``.
+
+Scope (refused with intent elsewhere): replicated params on a data-only
+mesh, ``--scan_layers`` stacks only. The embedding/head/final-LN grads
+outside the scanned stack keep GSPMD's fp32 psum — compression covers
+the O(num_layers) bulk, and ``parallel/sharding.describe`` logs both
+byte totals so the split is visible. Dropout streams fold the layer
+index and the data-axis coordinate (each replica draws its own mask for
+its shard) — statistically equivalent to the ``nn.scan`` path, not
+bit-interchangeable; parity tests pin the dropout-free math.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..runtime.context import DATA_AXIS
+from .overlap import _zero_cotangent
+from .shard_map_compat import shard_map
+
+#: supported wire formats for the per-layer gradient exchange
+GRAD_COMM_MODES = ("fp32", "bf16", "int8")
+
+#: int8 quantization bucket width: one fp32 scale per CHUNK values (the
+#: 1.6% scale overhead keeps int8 at ~0.25x fp32 wire bytes while bounding
+#: per-value error by its bucket's absmax/127, not the whole tensor's)
+CHUNK = 256
+
+
+def validate_ddp_mesh(mesh: Mesh | None) -> Mesh:
+    """Refuse meshes the compressed-DDP path cannot serve, with intent.
+
+    The reduce regions exchange gradients over ``data`` only and assume
+    replicated weights; a live ``model``/``seq``/... axis means the
+    params are not replicated and the region specs would silently
+    unshard them.
+    """
+    if mesh is None:
+        raise ValueError(
+            "--ddp_overlap needs the device mesh threaded into the model "
+            "(models/registry.py does this; pass mesh= when building "
+            "directly)"
+        )
+    extra = {name: size for name, size in mesh.shape.items()
+             if name != DATA_AXIS and size > 1}
+    if extra:
+        raise ValueError(
+            f"--ddp_overlap supports replicated-param data-parallel meshes "
+            f"only; mesh also has {extra} — drop the extra axes or drop "
+            "--ddp_overlap"
+        )
+    return mesh
+
+
+# -- quantizers ------------------------------------------------------------
+
+def stochastic_round_bf16(x: jax.Array, key: jax.Array) -> jax.Array:
+    """fp32 -> bf16 with stochastic mantissa rounding (unbiased).
+
+    Adds a uniform 16-bit integer below the kept mantissa and truncates:
+    the carry promotes with probability equal to the dropped fraction, so
+    ``E[sr(x)] == x`` exactly (magnitude-wise, hence value-wise — the
+    sign bit never participates in the carry).
+    """
+    bits = lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
+    noise = jax.random.bits(key, x.shape, jnp.uint16).astype(jnp.uint32)
+    rounded = (bits + noise) & jnp.uint32(0xFFFF0000)
+    return lax.bitcast_convert_type(rounded, jnp.float32).astype(jnp.bfloat16)
+
+
+def quantize_int8(x: jax.Array, key: jax.Array,
+                  chunk: int = CHUNK) -> tuple[jax.Array, jax.Array]:
+    """Chunked symmetric int8 quantization with stochastic rounding.
+
+    ``x``'s last dim must be a multiple of ``chunk``; returns
+    ``(q int8 (..., nb, chunk), scale f32 (..., nb, 1))`` with
+    ``scale = absmax/127`` per bucket (1.0 for all-zero buckets so the
+    dequant stays exact zeros). ``floor(y + u)`` with ``u ~ U[0, 1)`` is
+    unbiased for every real ``y``.
+    """
+    xb = x.reshape(*x.shape[:-1], x.shape[-1] // chunk, chunk)
+    amax = jnp.max(jnp.abs(xb), axis=-1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    y = xb.astype(jnp.float32) / scale
+    u = jax.random.uniform(key, y.shape, jnp.float32)
+    q = jnp.clip(jnp.floor(y + u), -127.0, 127.0).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_int8(q: jax.Array, scale: jax.Array) -> jax.Array:
+    """Inverse of :func:`quantize_int8`; returns the un-bucketed shape."""
+    out = q.astype(jnp.float32) * scale
+    return out.reshape(*q.shape[:-2], q.shape[-2] * q.shape[-1])
+
+
+# -- the wire: quantized reduce-scatter -> dequant-sum -> all-gather -------
+
+def padded_size(n_elems: int, data_size: int, chunk: int = CHUNK) -> int:
+    """Flat length after padding to a multiple of ``data_size * chunk``
+    (every replica's piece is a whole number of quantization buckets)."""
+    unit = data_size * chunk
+    return max(((n_elems + unit - 1) // unit) * unit, unit)
+
+
+def residual_shape(stacked_shape: tuple[int, ...], data_size: int,
+                   chunk: int = CHUNK) -> tuple[int, int, int]:
+    """Residual leaf shape for a stacked ``(L, *s)`` param leaf:
+    ``(L, data_size, padded)`` — one full flattened-grad residual per
+    replica per layer, sharded over ``data`` on dim 1."""
+    per_layer = int(np.prod(stacked_shape[1:])) if len(stacked_shape) > 1 else 1
+    return (stacked_shape[0], data_size, padded_size(per_layer, data_size,
+                                                     chunk))
+
+
+def init_residual(stacked: Any, data_size: int, chunk: int = CHUNK) -> Any:
+    """Zero error-feedback residual tree mirroring a stacked param tree."""
+    return jax.tree.map(
+        lambda x: jnp.zeros(residual_shape(x.shape, data_size, chunk),
+                            jnp.float32),
+        stacked,
+    )
+
+
+def _reduce_flat(flat: jax.Array, key: jax.Array | None, mode: str,
+                 axis_name: str, n: int, chunk: int,
+                 want_error: bool) -> tuple[jax.Array, jax.Array | None]:
+    """Cross-replica SUM of one flat padded vector, in ``mode`` precision.
+
+    Runs INSIDE a shard_map region over ``axis_name``. ``flat`` is this
+    replica's local partial (error-compensated when EF is on). Pipeline:
+    reshape to ``(n, piece)`` (row j is owner j's piece), quantize, ship
+    via ``all_to_all`` (the reduce-scatter phase: only quantized bytes
+    ride the wire), dequant-sum in fp32 on the owner, re-quantize the
+    sum, ``all_gather`` it back, dequant. Returns the replicated sum and
+    (when ``want_error``) this replica's total quantization error — the
+    phase-1 error everywhere plus the phase-2 error folded into the
+    owner's own row, so re-injecting it next step telescopes both.
+    """
+    pieces = flat.reshape(n, -1)
+    if mode == "fp32":
+        recv = lax.all_to_all(pieces, axis_name, 0, 0)
+        s = recv.sum(axis=0)
+        total = lax.all_gather(s, axis_name, axis=0)
+        return total.reshape(-1), None
+    k1, k2 = jax.random.split(key)
+    if mode == "bf16":
+        q = stochastic_round_bf16(pieces, k1)
+        sent = q.astype(jnp.float32)
+        recv = lax.all_to_all(q, axis_name, 0, 0)
+        s = recv.astype(jnp.float32).sum(axis=0)
+        q2 = stochastic_round_bf16(s, k2)
+        summed = q2.astype(jnp.float32)
+        total = lax.all_gather(q2, axis_name, axis=0).astype(jnp.float32)
+    elif mode == "int8":
+        q, sc = quantize_int8(pieces, k1, chunk)
+        sent = dequantize_int8(q, sc)
+        recvq = lax.all_to_all(q, axis_name, 0, 0)
+        recvs = lax.all_to_all(sc, axis_name, 0, 0)
+        s = dequantize_int8(recvq, jnp.broadcast_to(
+            recvs, recvq.shape[:-1] + (1,))).sum(axis=0)
+        q2, sc2 = quantize_int8(s[None], k2, chunk)
+        summed = dequantize_int8(q2, sc2)[0]
+        gq = lax.all_gather(q2[0], axis_name, axis=0)
+        gs = lax.all_gather(sc2[0], axis_name, axis=0)
+        total = dequantize_int8(gq, gs)
+    else:
+        raise ValueError(f"unknown grad_comm mode {mode!r}; "
+                         f"expected one of {GRAD_COMM_MODES}")
+    if not want_error:
+        return total.reshape(-1), None
+    # phase-1 error on every row; phase-2 error on the row this replica
+    # OWNS (row me stays local in the all_to_all, so next step's
+    # re-injection lands back in exactly the sum it mis-rounded)
+    err = pieces - sent
+    me = lax.axis_index(axis_name)
+    own = (jnp.arange(n) == me).astype(jnp.float32)[:, None]
+    err = err + own * (s - summed)[None, :]
+    return total.reshape(-1), err.reshape(-1)
+
+
+def _leaf_allreduce(g: jax.Array, e_loc: jax.Array | None,
+                    key: jax.Array | None, mode: str, axis_name: str,
+                    n: int, chunk: int) -> tuple[jax.Array,
+                                                 jax.Array | None]:
+    """Per-leaf compressed cross-replica sum (inside the region).
+
+    ``g`` is the local partial grad (full leaf shape); ``e_loc`` the
+    local residual ``(1, padded)`` or None. Pads, compensates, reduces,
+    unpads."""
+    flat = g.reshape(-1).astype(jnp.float32)
+    pad = padded_size(flat.size, n, chunk)
+    if pad != flat.size:
+        flat = jnp.pad(flat, (0, pad - flat.size))
+    if e_loc is not None:
+        flat = flat + e_loc.reshape(-1)
+    total, err = _reduce_flat(flat, key, mode, axis_name, n, chunk,
+                              want_error=e_loc is not None)
+    out = total[: g.size].reshape(g.shape).astype(g.dtype)
+    return out, None if err is None else err.reshape(1, pad)
+
+
+def _reduce_tree(gw: Any, res: Any | None, key: jax.Array | None, mode: str,
+                 axis_name: str, n: int,
+                 chunk: int) -> tuple[Any, Any | None]:
+    """Tree-mapped :func:`_leaf_allreduce` with per-leaf key folds."""
+    leaves, treedef = jax.tree.flatten(gw)
+    res_leaves = (jax.tree.leaves(res) if res is not None
+                  else [None] * len(leaves))
+    if len(res_leaves) != len(leaves):
+        raise ValueError(
+            f"error-feedback residual has {len(res_leaves)} leaves but the "
+            f"gradient tree has {len(leaves)} — the residual must mirror "
+            "the stacked params it compensates"
+        )
+    outs, errs = [], []
+    for i, (g, e) in enumerate(zip(leaves, res_leaves)):
+        k_i = None if key is None else jax.random.fold_in(key, i)
+        o, err = _leaf_allreduce(g, e, k_i, mode, axis_name, n, chunk)
+        outs.append(o)
+        errs.append(err)
+    new_res = (None if res is None
+               else jax.tree.unflatten(jax.tree.structure(res), errs))
+    return jax.tree.unflatten(treedef, outs), new_res
+
+
+def compressed_allreduce(partials: Any, mesh: Mesh, mode: str, *,
+                         rng: jax.Array | None = None,
+                         residual: Any | None = None,
+                         chunk: int = CHUNK) -> tuple[Any, Any | None]:
+    """Standalone compressed cross-replica SUM (unit-test surface + the
+    building block :func:`ddp_overlap_scan` issues per layer).
+
+    ``partials``: tree of ``(data_size, *s)`` arrays sharded over ``data``
+    on dim 0 — row i is replica i's partial. ``residual``: tree of
+    ``(data_size, padded)`` arrays (same sharding) or None. Returns
+    ``(sums, new_residual)`` where each sums leaf is ``(data_size, *s)``
+    with every row holding the identical reduced value.
+    """
+    validate_ddp_mesh(mesh)
+    n = mesh.shape.get(DATA_AXIS, 1)
+    if mode not in GRAD_COMM_MODES:
+        raise ValueError(f"unknown grad_comm mode {mode!r}; "
+                         f"expected one of {GRAD_COMM_MODES}")
+    if mode != "fp32" and rng is None:
+        raise ValueError(f"grad_comm={mode!r} needs an rng for stochastic "
+                         "rounding")
+    if residual is not None and mode == "fp32":
+        # same refusal as ddp_overlap_scan: an fp32 exchange has no
+        # quantization error to feed back, and the region would otherwise
+        # die on an out_specs structure mismatch instead of saying so
+        raise ValueError("error-feedback residual with grad_comm=fp32 is "
+                         "a no-op by construction; drop one of the two")
+
+    sh = P(DATA_AXIS)
+    in_specs = (jax.tree.map(lambda _: sh, partials),
+                jax.tree.map(lambda _: sh, residual),
+                None if rng is None else P())
+    out_specs = (jax.tree.map(lambda _: sh, partials),
+                 jax.tree.map(lambda _: sh, residual))
+
+    def region(parts, res, key):
+        local = jax.tree.map(lambda x: x[0], parts)
+        out, err = _reduce_tree(local, res, key, mode, DATA_AXIS, n, chunk)
+        return jax.tree.map(lambda x: x[None], out), err
+
+    return shard_map(region, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_vma=False)(
+        partials, residual, rng)
+
+
+# -- the scan: per-layer backward with in-iteration compressed reduce ------
+
+
+def _slice_layer(stacked: Any, k: jax.Array) -> Any:
+    return jax.tree.map(
+        lambda a: lax.dynamic_index_in_dim(a, k, 0, keepdims=False), stacked)
+
+
+def ddp_overlap_scan(apply_fn: Callable[[Any, jax.Array, jax.Array, Any],
+                                        jax.Array],
+                     stacked: Any, x: jax.Array, extras: Any,
+                     extras_specs: Any, mesh: Mesh, *,
+                     grad_comm: str = "fp32",
+                     residual: Any | None = None,
+                     comm_rng: jax.Array | None = None,
+                     chunk: int = CHUNK) -> jax.Array:
+    """Run ``apply_fn(layer_params, y, k, extras)`` over the stacked
+    layers with per-layer cross-replica grad reduces issued inside the
+    backward scan iteration, in ``grad_comm`` wire precision.
+
+    ``apply_fn`` is traced INSIDE a ``shard_map`` region over ``data``
+    in both directions: it sees the per-replica batch shard, so the
+    gradients its vjp produces are true per-replica partials — the
+    quantity a compressed reduce must start from (at the GSPMD level
+    partials are unobservable: any replicated consumer triggers the
+    implicit fp32 psum). ``extras`` rides as an explicit primal
+    (custom_vjp forbids closing over tracers) with ``extras_specs``
+    giving each leaf's region spec (batch-sharded mask vs replicated
+    rng).
+
+    Forward: a plain ``lax.scan`` saving only the layer-boundary
+    activations. Backward (the custom-vjp rule): a reverse scan whose
+    body recomputes layer k's block from the saved boundary activation
+    (implicit block remat, as in ``overlap_scan``), vjps it locally, and
+    reduces that layer's grads immediately — each iteration's reduce
+    consumes only its own layer's compute, so the scheduler may drain it
+    while layer k-1's backward runs. With ``residual`` (error feedback),
+    each layer's residual slice is compensated and its update returned
+    through the residual input's cotangent slot.
+    """
+    validate_ddp_mesh(mesh)
+    if grad_comm not in GRAD_COMM_MODES:
+        raise ValueError(f"unknown grad_comm mode {grad_comm!r}; "
+                         f"expected one of {GRAD_COMM_MODES}")
+    if grad_comm != "fp32" and comm_rng is None:
+        raise ValueError(f"grad_comm={grad_comm!r} needs comm_rng for "
+                         "stochastic rounding")
+    if residual is not None and grad_comm == "fp32":
+        raise ValueError("error-feedback residual with grad_comm=fp32 is "
+                         "a no-op by construction; drop one of the two")
+    n = mesh.shape.get(DATA_AXIS, 1)
+    leaves = jax.tree.leaves(stacked)
+    if not leaves:
+        raise ValueError("ddp_overlap_scan: empty stacked parameter tree")
+    num_layers = int(leaves[0].shape[0])
+    ks = jnp.arange(num_layers, dtype=jnp.int32)
+
+    rep = lambda tree: jax.tree.map(lambda _: P(), tree)
+    layer_specs = rep(_slice_layer(stacked, jnp.asarray(0)))
+    x_spec = P(DATA_AXIS)
+    res_slice = (None if residual is None
+                 else _slice_layer(residual, jnp.asarray(0)))
+    res_specs = jax.tree.map(lambda _: P(DATA_AXIS), res_slice)
+
+    fwd_apply = shard_map(
+        lambda w, y, k, e: apply_fn(w, y, k, e),
+        mesh=mesh, in_specs=(layer_specs, x_spec, P(), extras_specs),
+        out_specs=x_spec, check_vma=False)
+
+    def _bwd_region(w, x_k, gy, k, e, res_k, key):
+        # the whole per-layer vjp runs on the local shard: every op in
+        # the block is per-example, so these are the true local partials
+        _, pull = jax.vjp(lambda w_, y_: apply_fn(w_, y_, k, e), w, x_k)
+        gw, gx = pull(gy)
+        gw_sum, res_new = _reduce_tree(gw, res_k, key, grad_comm,
+                                       DATA_AXIS, n, chunk)
+        return gw_sum, gx, res_new
+
+    bwd_apply = shard_map(
+        _bwd_region, mesh=mesh,
+        in_specs=(layer_specs, x_spec, x_spec, P(), extras_specs,
+                  res_specs, None if comm_rng is None else P()),
+        out_specs=(layer_specs, x_spec, res_specs), check_vma=False)
+
+    @jax.custom_vjp
+    def run(stacked, x, extras, residual, comm_rng):
+        def body(y, k):
+            return fwd_apply(_slice_layer(stacked, k), y, k, extras), None
+
+        y, _ = lax.scan(body, x, ks)
+        return y
+
+    def run_fwd(stacked, x, extras, residual, comm_rng):
+        def body(y, k):
+            y_out = fwd_apply(_slice_layer(stacked, k), y, k, extras)
+            # save each layer's INPUT activation — the only O(L)
+            # residual; blocks recompute from it in backward
+            return y_out, y
+
+        y, acts = lax.scan(body, x, ks)
+        return y, (stacked, acts, extras, residual, comm_rng)
+
+    def run_bwd(res, gy):
+        stacked, acts, extras, residual, comm_rng = res
+
+        def body(gy, inputs):
+            k, x_k, res_k = inputs
+            key_k = (None if comm_rng is None
+                     else jax.random.fold_in(comm_rng, k))
+            gw_sum, gx, res_new = bwd_apply(
+                _slice_layer(stacked, k), x_k, gy, k, extras, res_k, key_k)
+            # per-layer drain: gw_sum is fully reduced HERE, inside the
+            # iteration — independent of every earlier layer's backward
+            return gx, (gw_sum, res_new)
+
+        gx, (gws, new_res) = lax.scan(
+            body, gy, (ks, acts, residual), reverse=True)
+        res_ct = new_res if residual is not None else None
+        key_ct = (None if comm_rng is None
+                  else np.zeros(np.shape(comm_rng), jax.dtypes.float0))
+        return gws, gx, _zero_cotangent(extras), res_ct, key_ct
+
+    run.defvjp(run_fwd, run_bwd)
+    return run(stacked, x, extras, residual, comm_rng)
+
+
+# -- evidence --------------------------------------------------------------
+
+def wire_bytes_per_step(stacked: Any, data_size: int, mode: str,
+                        chunk: int = CHUNK) -> int:
+    """Estimated gradient bytes on the wire per optimizer step for a
+    stacked ``(L, ...)`` tree under ``mode``.
+
+    Counts both phases' payload (quantized reduce-scatter + re-quantized
+    all-gather) over the padded flat length, plus the int8 per-bucket
+    fp32 scales. An upper bound: the all_to_all keeps 1/data_size of the
+    payload local, which this deliberately does not discount (the
+    fp32-vs-quantized *ratios* are exact either way). The GSPMD fp32
+    baseline costs ``2 * 4 * size`` per leaf (ring all-reduce moves ~2x
+    the data).
+    """
+    if mode not in GRAD_COMM_MODES:
+        raise ValueError(f"unknown grad_comm mode {mode!r}")
+    total = 0
+    for leaf in jax.tree.leaves(stacked):
+        per_layer = int(np.prod(leaf.shape[1:])) if leaf.ndim > 1 else 1
+        pad = padded_size(per_layer, data_size, chunk)
+        if mode == "fp32":
+            per = 2 * 4 * pad
+        elif mode == "bf16":
+            per = 2 * 2 * pad
+        else:  # int8: values + one f32 scale per bucket, both phases
+            per = 2 * (pad + 4 * (pad // chunk))
+        total += int(leaf.shape[0]) * per
+    return total
+
+
+def hlo_comms_evidence(hlo_text: str, num_layers: int) -> dict[str, Any]:
+    """Analyse compiled HLO for the per-layer in-scan reduce signature.
+
+    Builds on ``parallel/overlap.hlo_overlap_evidence``'s loop-body
+    dependency analysis, with ``all-to-all`` added to the collective set
+    (the compressed reduce-scatter phase lowers to it). A dot-carrying
+    scan body that contains reduce collectives executes them once per
+    layer iteration; each iteration's reduce consumes only that layer's
+    gradients, so the ``num_layers`` dynamic instances are mutually
+    independent — the schedulable per-layer drain. Headline:
+    ``inscan_reduce_collectives`` (= per-body count x trip count, the
+    number of independent reduce launches per step) and
+    ``per_layer_reduce`` (>= 1 reduce collective lives inside a
+    dot-carrying loop body at all — under GSPMD-default DDP the grad
+    all-reduce sits outside the scan instead).
+    """
+    from .overlap import hlo_overlap_evidence
+
+    ev = hlo_overlap_evidence(
+        hlo_text,
+        collectives=("all-reduce", "all-gather", "reduce-scatter",
+                     "collective-permute", "all-to-all"),
+    )
+    bodies = ev["bodies"]
+    per_body = max((r["collectives"] for r in bodies), default=0)
+    return {
+        "bodies": bodies,
+        "bwd_body_collectives": per_body,
+        "inscan_reduce_collectives": per_body * num_layers,
+        "per_layer_reduce": per_body >= 1,
+    }

@@ -307,7 +307,9 @@ def overlap_scan(apply_fn: Callable[[Any, jax.Array, jax.Array, Any],
 
 # -- HLO schedule evidence -------------------------------------------------
 
-def hlo_overlap_evidence(hlo_text: str) -> dict[str, Any]:
+def hlo_overlap_evidence(hlo_text: str,
+                         collectives: tuple[str, ...] | None = None,
+                         ) -> dict[str, Any]:
     """Analyse compiled HLO for the decomposed schedule's signature.
 
     For every non-entry computation that contains both matmuls and a
@@ -334,11 +336,16 @@ def hlo_overlap_evidence(hlo_text: str) -> dict[str, Any]:
     a compute-independent collective — the forward prefetch) and
     ``bwd_regather_independent`` (≥2 such bodies — the backward re-gather
     pipeline too).
+
+    ``collectives`` overrides the default op set — ``parallel/compress.py``
+    adds ``all-to-all`` (its reduce-scatter phase) when analysing the
+    compressed-DDP schedule.
     """
     import re
 
-    collectives = ("all-reduce", "all-gather", "reduce-scatter",
-                   "collective-permute")
+    if collectives is None:
+        collectives = ("all-reduce", "all-gather", "reduce-scatter",
+                       "collective-permute")
     bodies = []
     cur: list[str] | None = None
     name = ""

@@ -229,6 +229,42 @@ def describe(mesh: Mesh, config: Any = None,
                                 else "gspmd-default")
         elif getattr(config, "zero1", False):
             out["fsdp_mode"] = "zero1"
+        if getattr(config, "ddp_overlap", False):
+            # which wire the DDP grad reduce rides, and how many bytes:
+            # the run log must show the compression is actually active
+            # (mirrors fsdp_mode above). Stacked-layer grads ride the
+            # compressed per-layer path; everything outside the scanned
+            # stack (embeddings, heads, final norms) keeps GSPMD's fp32
+            # psum — both totals are reported so the split is visible.
+            out["ddp_mode"] = "per-layer-overlapped-reduce"
+            out["grad_comm"] = getattr(config, "grad_comm", "fp32")
+            out["grad_error_feedback"] = bool(
+                getattr(config, "grad_error_feedback", False))
+            if params is not None:
+                from .compress import wire_bytes_per_step
+                from .stacking import LAYER_AXIS
+
+                unboxed = nn.meta.unbox(params)
+                n = sizes.get(DATA_AXIS, 1)
+                flat, _ = jax.tree_util.tree_flatten_with_path(unboxed)
+
+                def _in_stack(path):
+                    return any(
+                        getattr(p, "key", getattr(p, "name", None))
+                        == LAYER_AXIS
+                        for p in path
+                    )
+
+                stacked = [leaf for path, leaf in flat if _in_stack(path)]
+                rest = [leaf for path, leaf in flat if not _in_stack(path)]
+                # GSPMD fp32 ring all-reduce moves ~2x the data
+                rest_bytes = sum(2 * 4 * leaf.size for leaf in rest)
+                comp = wire_bytes_per_step(stacked, n, out["grad_comm"])
+                base = wire_bytes_per_step(stacked, n, "fp32")
+                out["grad_wire_mb_per_step"] = round(
+                    (comp + rest_bytes) / 1e6, 3)
+                out["grad_wire_mb_fp32"] = round(
+                    (base + rest_bytes) / 1e6, 3)
         if getattr(config, "fsdp", False) and params is not None:
             # read the PLACED shardings, not a re-derivation: under TP some
             # dims already carry the model axis and the chooser would lie

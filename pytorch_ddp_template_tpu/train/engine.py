@@ -48,7 +48,7 @@ from ..checkpoint.manager import CheckpointManager
 from ..config import TrainingConfig
 from ..data.loader import ShardedLoader
 from ..models.task import Task
-from ..runtime.context import RuntimeContext
+from ..runtime.context import DATA_AXIS, RuntimeContext
 from ..utils import get_logger, is_main_process
 from ..utils.divergence import DivergenceMonitor
 from ..utils.profiler import StepTimer, TraceWindow
@@ -60,13 +60,25 @@ log = get_logger(__name__)
 
 class TrainState(flax.struct.PyTreeNode):
     """Replicated training state. ``extra_vars`` holds non-param collections
-    (e.g. BatchNorm ``batch_stats``); ``rng`` is the shared base key."""
+    (e.g. BatchNorm ``batch_stats``); ``rng`` is the shared base key.
+
+    ``comm_residual`` (``--grad_error_feedback``) is the per-replica
+    gradient-compression residual — NOT replicated: leaves are
+    ``(num_layers, data_size, padded)`` sharded over ``data`` on dim 1
+    (``parallel/compress.py``). It is the one field the backward pass
+    writes: the compressed per-layer reduce returns the updated residual
+    through its primal input's cotangent slot, and ``step_fn`` threads
+    that cotangent back in here. ``None`` whenever error feedback is off
+    (the default), in which case checkpoints are byte-compatible with
+    pre-residual ones (``checkpoint/manager.py`` stores the residual as
+    a separate item)."""
 
     step: jax.Array
     params: Any
     extra_vars: Any
     opt_state: Any
     rng: jax.Array
+    comm_residual: Any = None
 
 
 def make_optimizer(config: TrainingConfig, total_steps: int) -> tuple[optax.GradientTransformation, optax.Schedule]:
@@ -174,12 +186,42 @@ def make_train_step(
     def step_fn(state: TrainState, batch: dict[str, jax.Array],
                 stop_flags: jax.Array | None = None):
         rng = jax.random.fold_in(state.rng, state.step)
+        # static pytree-structure property: error feedback is on exactly
+        # when the state carries a residual tree
+        ef = getattr(state, "comm_residual", None) is not None
+        new_residual = state.comm_residual if ef else None
 
         if accum_steps == 1:
-            (loss, (new_extra, metrics)), grads = grad_fn(
-                state.params, state.extra_vars, batch, rng
-            )
+            if ef:
+                # the compressed per-layer reduce updates the residual in
+                # BACKWARD; the only in-jit channel for backward-produced
+                # state is a cotangent, so the residual rides into the
+                # model as the "comm_residual" collection and its updated
+                # value comes back as that input's "gradient"
+                # (parallel/compress.py module docstring)
+                ev_in = {**state.extra_vars,
+                         "comm_residual": state.comm_residual}
+                (loss, (new_extra, metrics)), (grads, ev_ct) = (
+                    jax.value_and_grad(loss_fn, argnums=(0, 1),
+                                       has_aux=True)(
+                        state.params, ev_in, batch, rng))
+                new_residual = ev_ct["comm_residual"]
+                new_extra = {k: v for k, v in dict(new_extra).items()
+                             if k != "comm_residual"}
+            else:
+                (loss, (new_extra, metrics)), grads = grad_fn(
+                    state.params, state.extra_vars, batch, rng
+                )
         else:
+            if ef:
+                # sequential EF semantics (each microbatch compensates the
+                # previous one's residual) cannot ride the accumulation
+                # scan; config.__post_init__ refuses the combination, this
+                # guards direct make_train_step users
+                raise ValueError(
+                    "--grad_error_feedback does not compose with "
+                    "gradient accumulation; see config.py"
+                )
             # lax.scan over microbatches: sum grads, thread extra_vars
             # (BatchNorm stats advance per microbatch, like the reference's
             # sequential micro-steps).
@@ -216,6 +258,7 @@ def make_train_step(
             params=new_params,
             extra_vars=new_extra,
             opt_state=new_opt_state,
+            comm_residual=new_residual,
         )
         out_metrics = dict(metrics)
         # tasks report the pure data loss in metrics (comparable with eval
@@ -314,6 +357,13 @@ class Trainer:
             example = jax.tree.map(lambda x: x[0], example)
         params, extra = self.task.init(self.ctx.seed_key, example)
         opt_state = self.tx.init(params)
+        # the error-feedback residual inits as a model collection (the
+        # encoder declares it, so the collection path is pathed by flax)
+        # but lives as its own TrainState field: it is per-replica state
+        # the optimizer must never touch, clipped by nothing, written by
+        # the backward pass
+        residual = (extra.pop("comm_residual", None)
+                    if isinstance(extra, dict) else None)
         state = TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,
@@ -322,6 +372,10 @@ class Trainer:
             # clone: the state is donated every step, and donating the
             # context's own key buffer would delete it for later use
             rng=jax.random.clone(self.ctx.seed_key),
+            # attached after shard_tree: the residual is per-replica, and
+            # letting shard_tree replicate it first would transiently
+            # cost data_size x the stacked params PER DEVICE in fp32
+            comm_residual=None,
         )
         # Place the state onto the mesh per its logical annotations: the
         # DDP-construction param broadcast (ddp.py:194-195) as a sharding —
@@ -332,6 +386,13 @@ class Trainer:
         )
 
         state = shard_tree(state, self.ctx.mesh)
+        if residual is not None:
+            # per-replica residual: (L, data_size, padded) leaves split
+            # over ``data`` on dim 1 — each replica holds exactly its own
+            # compensation state, placed directly (never replicated)
+            res_sh = NamedSharding(self.ctx.mesh, P(None, DATA_AXIS))
+            state = state.replace(comm_residual=jax.tree.map(
+                lambda x: jax.device_put(x, res_sh), residual))
         # scan-over-layers stacks every block weight on a leading
         # (num_layers, ...) dim — prefer splitting THERE so the whole
         # stack shards uniformly at layer granularity (one dividable axis

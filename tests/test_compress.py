@@ -1,0 +1,579 @@
+"""Compressed-DDP grad collectives (``--ddp_overlap`` / ``--grad_comm`` /
+``--grad_error_feedback``, parallel/compress.py): the quantizers must be
+bounded and unbiased, the compressed wire must reduce exactly (fp32) or
+within quantization bounds (bf16/int8), the error-feedback residual must
+telescope (sum of applied updates == sum of true gradients minus one final
+residual), the overlapped scan must reproduce straight-line values and
+grads, refusals must fail with intent, and checkpoints must round-trip the
+residual forward AND backward compatibly."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from pytorch_ddp_template_tpu.config import TrainingConfig
+from pytorch_ddp_template_tpu.models import build
+from pytorch_ddp_template_tpu.parallel.compress import (
+    CHUNK,
+    compressed_allreduce,
+    ddp_overlap_scan,
+    dequantize_int8,
+    init_residual,
+    padded_size,
+    quantize_int8,
+    stochastic_round_bf16,
+    validate_ddp_mesh,
+    wire_bytes_per_step,
+)
+from pytorch_ddp_template_tpu.runtime import make_mesh
+
+#: same tolerance family as tests/test_overlap.py: observed fp32-path gap
+#: vs the GSPMD baseline is reduction reassociation at the last f32 ulp
+#: (~4e-9 on params, ~1e-7 on a token-mean loss); 1e-6 is pure headroom
+TOL = 1e-6
+
+
+def _max_abs_diff(a, b):
+    return max(
+        float(jnp.max(jnp.abs(x.astype(jnp.float32) - y.astype(jnp.float32))))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+# -- quantizer units -------------------------------------------------------
+
+class TestQuantizers:
+    def test_int8_roundtrip_error_bounded_per_bucket(self):
+        x = jnp.asarray(np.random.default_rng(0).standard_normal(
+            (4, 2 * CHUNK)).astype(np.float32) * 3.0)
+        q, scale = quantize_int8(x, jax.random.PRNGKey(1))
+        back = dequantize_int8(q, scale)
+        # stochastic rounding moves at most one quantum = one bucket scale
+        err = jnp.abs(back.reshape(4, 2, CHUNK) - x.reshape(4, 2, CHUNK))
+        assert float(jnp.max(err - scale.reshape(4, 2, 1))) <= 1e-6
+
+    def test_int8_zero_bucket_stays_exact_zero(self):
+        x = jnp.zeros((1, CHUNK))
+        q, scale = quantize_int8(x, jax.random.PRNGKey(0))
+        assert float(jnp.abs(dequantize_int8(q, scale)).max()) == 0.0
+
+    def test_int8_stochastic_rounding_unbiased(self):
+        """Mean over many independent rounding draws must converge to the
+        true value (the satellite's unbiasedness pin): |bias| is held to a
+        few standard errors of the quantum-sized per-draw noise."""
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.standard_normal((CHUNK,)).astype(np.float32))
+        n_draws = 512
+        keys = jax.random.split(jax.random.PRNGKey(3), n_draws)
+        draws = jax.vmap(
+            lambda k: dequantize_int8(*quantize_int8(x[None], k))[0])(keys)
+        mean = np.asarray(jnp.mean(draws, axis=0))
+        quantum = float(jnp.max(jnp.abs(x))) / 127.0
+        # per-draw SR error is Bernoulli over one quantum: sd <= q/2
+        bound = 4.0 * 0.5 * quantum / np.sqrt(n_draws)
+        assert np.max(np.abs(mean - np.asarray(x))) < bound + 1e-7
+
+    def test_bf16_stochastic_rounding_bounded_and_unbiased(self):
+        rng = np.random.default_rng(4)
+        x = jnp.asarray(rng.standard_normal((256,)).astype(np.float32))
+        n_draws = 512
+        keys = jax.random.split(jax.random.PRNGKey(5), n_draws)
+        draws = jax.vmap(
+            lambda k: stochastic_round_bf16(x, k).astype(jnp.float32))(keys)
+        # each draw within one bf16 ulp (7 explicit mantissa bits ->
+        # relative spacing up to 2^-7 just above a power of two)
+        rel = jnp.max(jnp.abs(draws - x[None]) / jnp.abs(x)[None])
+        assert float(rel) <= 2.0 ** -7 + 1e-6
+        mean = np.asarray(jnp.mean(draws, axis=0))
+        ulp = np.abs(np.asarray(x)) * 2.0 ** -7
+        # per-draw SR error is Bernoulli over one ulp: sd <= ulp/2
+        bound = 4.0 * 0.5 * ulp / np.sqrt(n_draws)
+        assert np.max(np.abs(mean - np.asarray(x)) - bound) < 1e-7
+
+
+# -- the wire --------------------------------------------------------------
+
+def _partials(n, shape, seed=0, scale=1.0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.standard_normal((n,) + shape).astype(np.float32)
+                       * scale)
+
+
+class TestCompressedAllreduce:
+    def test_fp32_matches_dense_sum(self, devices):
+        mesh = make_mesh("data:-1")
+        n = mesh.shape["data"]
+        host = {"a": _partials(n, (300,), 0), "b": _partials(n, (3, 5), 1)}
+        sharded = jax.tree.map(
+            lambda x: jax.device_put(x, NamedSharding(mesh, P("data"))),
+            host)
+        out, res = compressed_allreduce(sharded, mesh, "fp32")
+        assert res is None
+        for k, v in host.items():
+            want = np.asarray(v).sum(axis=0)
+            got = np.asarray(out[k])
+            for row in got:  # every replica row holds the identical sum
+                np.testing.assert_allclose(row, want, atol=1e-5)
+
+    @pytest.mark.parametrize("mode", ["bf16", "int8"])
+    def test_lossy_modes_error_bounded(self, devices, mode):
+        mesh = make_mesh("data:-1")
+        n = mesh.shape["data"]
+        host = {"w": _partials(n, (2 * CHUNK,), 2)}
+        sharded = jax.tree.map(
+            lambda x: jax.device_put(x, NamedSharding(mesh, P("data"))),
+            host)
+        out, _ = compressed_allreduce(sharded, mesh, mode,
+                                      rng=jax.random.PRNGKey(0))
+        want = np.asarray(host["w"]).sum(axis=0)
+        got = np.asarray(out["w"])[0]
+        # n quantized contributions + one re-quantized sum: error is a
+        # few quanta of the (absmax-sized) bucket scales
+        scale = np.abs(np.asarray(host["w"])).max() / (
+            127.0 if mode == "int8" else 256.0)
+        bound = (n + 2) * scale * (2.0 if mode == "bf16" else 1.0)
+        # bf16's "scale" is value-relative; use the sum's own magnitude
+        if mode == "bf16":
+            bound = (np.abs(np.asarray(host["w"])).sum(0).max()) * 2 ** -7
+        assert np.max(np.abs(got - want)) < bound
+
+    def test_error_feedback_telescopes_exactly(self, devices):
+        """Sum of compressed outputs + every replica's final residual ==
+        sum of true inputs (exact identity, satellite pin), and the
+        cumulative EF error is strictly smaller than no-EF's random walk."""
+        mesh = make_mesh("data:-1")
+        n = mesh.shape["data"]
+        F = 2 * CHUNK
+        pad = padded_size(F, n)
+        sh = NamedSharding(mesh, P("data"))
+        residual = {"w": jax.device_put(jnp.zeros((n, pad)), sh)}
+        steps, key = 20, jax.random.PRNGKey(7)
+        # jit ONCE: a bare compressed_allreduce call builds a fresh
+        # shard_map per invocation and would re-trace every step
+        ef_call = jax.jit(lambda g, r, k: compressed_allreduce(
+            g, mesh, "int8", rng=k, residual=r))
+        ne_call = jax.jit(lambda g, k: compressed_allreduce(
+            g, mesh, "int8", rng=k))
+        total_true = np.zeros((F,), np.float64)
+        total_ef = np.zeros((F,), np.float64)
+        total_no_ef = np.zeros((F,), np.float64)
+        for t in range(steps):
+            g = {"w": jax.device_put(_partials(n, (F,), 100 + t), sh)}
+            total_true += np.asarray(g["w"]).sum(axis=0)
+            k = jax.random.fold_in(key, t)
+            out_ef, residual = ef_call(g, residual, k)
+            total_ef += np.asarray(out_ef["w"])[0]
+            out_ne, _ = ne_call(g, k)
+            total_no_ef += np.asarray(out_ne["w"])[0]
+        res_sum = np.asarray(residual["w"]).sum(axis=0)[:F]
+        # the telescoping identity (f32 arithmetic headroom only)
+        np.testing.assert_allclose(total_ef + res_sum, total_true,
+                                   atol=5e-4)
+        ef_err = np.abs(total_ef - total_true).max()
+        no_ef_err = np.abs(total_no_ef - total_true).max()
+        assert ef_err <= np.abs(res_sum).max() + 5e-4
+        assert ef_err < no_ef_err
+
+    def test_refusals(self, devices):
+        mesh = make_mesh("data:-1")
+        with pytest.raises(ValueError, match="unknown grad_comm"):
+            compressed_allreduce({"w": jnp.zeros((8, 4))}, mesh, "fp16")
+        with pytest.raises(ValueError, match="stochastic rounding"):
+            compressed_allreduce({"w": jnp.zeros((8, 4))}, mesh, "int8")
+        with pytest.raises(ValueError, match="no-op by construction"):
+            compressed_allreduce({"w": jnp.zeros((8, 4))}, mesh, "fp32",
+                                 residual={"w": jnp.zeros((8, 256))})
+        with pytest.raises(ValueError, match="data-parallel meshes only"):
+            validate_ddp_mesh(make_mesh("data:4,model:2"))
+        with pytest.raises(ValueError, match="mesh"):
+            validate_ddp_mesh(None)
+
+
+# -- the scan --------------------------------------------------------------
+
+class TestDdpOverlapScan:
+    def test_matches_reference_values_and_grads(self, devices):
+        """Toy stack y_{k+1} = tanh(y_k @ W_k): the per-layer-reduced
+        custom-vjp scan agrees with straight-line math in value and in
+        grads wrt weights AND input (the --grad_comm fp32 parity pin)."""
+        mesh = make_mesh("data:-1")
+        L, d, B = 4, 6, 16
+        rng = np.random.default_rng(1)
+        w_host = rng.standard_normal((L, d, d)).astype(np.float32) * 0.3
+        x_host = rng.standard_normal((B, d)).astype(np.float32)
+        stacked = {"w": jnp.asarray(w_host)}
+        x = jax.device_put(jnp.asarray(x_host),
+                           NamedSharding(mesh, P("data")))
+
+        def apply_one(w, y, k, extras):
+            return jnp.tanh(y @ w["w"])
+
+        def overlap_loss(stacked, x):
+            return jnp.mean(ddp_overlap_scan(
+                apply_one, stacked, x, (), (), mesh) ** 2)
+
+        def ref_loss(w, x):
+            y = x
+            for k in range(L):
+                y = jnp.tanh(y @ w[k])
+            return jnp.mean(y ** 2)
+
+        lo, (gs, gx) = jax.jit(
+            jax.value_and_grad(overlap_loss, argnums=(0, 1)))(stacked, x)
+        lr, (gw_ref, gx_ref) = jax.jit(
+            jax.value_and_grad(ref_loss, argnums=(0, 1)))(
+            jnp.asarray(w_host), jnp.asarray(x_host))
+        np.testing.assert_allclose(float(lo), float(lr), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(gs["w"]), np.asarray(gw_ref),
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_ref),
+                                   atol=1e-5)
+
+    def test_int8_residual_cotangent_telescopes(self, devices):
+        """int8 through the scan: grads land within quantization error of
+        the true grads, and the residual cotangent carries exactly the
+        error kept back — truth = compressed + summed residual."""
+        mesh = make_mesh("data:-1")
+        n = mesh.shape["data"]
+        L, d, B = 3, 6, 16
+        rng = np.random.default_rng(3)
+        stacked = {"w": jnp.asarray(
+            rng.standard_normal((L, d, d)).astype(np.float32) * 0.3)}
+        x = jax.device_put(
+            jnp.asarray(rng.standard_normal((B, d)).astype(np.float32)),
+            NamedSharding(mesh, P("data")))
+        res = jax.tree.map(
+            lambda r: jax.device_put(r, NamedSharding(mesh, P(None, "data"))),
+            init_residual(stacked, n))
+        key = jax.random.PRNGKey(9)
+
+        def apply_one(w, y, k, extras):
+            return jnp.tanh(y @ w["w"])
+
+        def loss(stacked, res, x, mode, r):
+            return jnp.mean(ddp_overlap_scan(
+                apply_one, stacked, x, (), (), mesh, grad_comm=mode,
+                residual=r, comm_rng=key if mode != "fp32" else None) ** 2)
+
+        _, gw_true = jax.jit(jax.value_and_grad(
+            lambda s: loss(s, None, x, "fp32", None)))(stacked)
+        _, (gw8, res_ct) = jax.jit(jax.value_and_grad(
+            lambda s, r: loss(s, r, x, "int8", r), argnums=(0, 1)))(
+            stacked, res)
+        recon = gw8["w"] + jnp.sum(res_ct["w"], axis=1)[
+            :, : d * d].reshape(L, d, d)
+        np.testing.assert_allclose(np.asarray(recon),
+                                   np.asarray(gw_true["w"]), atol=1e-5)
+        # and int8 alone is close-but-not-exact (compression really ran)
+        assert 0 < _max_abs_diff(gw8, gw_true) < 0.1
+
+    def test_refusals(self, devices):
+        mesh = make_mesh("data:-1")
+        stacked = {"w": jnp.zeros((2, 4, 4))}
+        with pytest.raises(ValueError, match="needs comm_rng"):
+            ddp_overlap_scan(lambda w, y, k, e: y, stacked,
+                             jnp.zeros((8, 4)), (), (), mesh,
+                             grad_comm="int8")
+        with pytest.raises(ValueError, match="no-op by construction"):
+            ddp_overlap_scan(lambda w, y, k, e: y, stacked,
+                             jnp.zeros((8, 4)), (), (), mesh,
+                             grad_comm="fp32", residual={"w": jnp.zeros(1)})
+        with pytest.raises(ValueError, match="empty stacked"):
+            ddp_overlap_scan(lambda w, y, k, e: y, {}, jnp.zeros((8, 4)),
+                             (), (), mesh)
+
+
+# -- wire bytes ------------------------------------------------------------
+
+def test_wire_bytes_ratios(devices):
+    stacked = {"k": jnp.zeros((4, 64, 64)), "b": jnp.zeros((4, 64))}
+    n = 8
+    fp32 = wire_bytes_per_step(stacked, n, "fp32")
+    bf16 = wire_bytes_per_step(stacked, n, "bf16")
+    int8 = wire_bytes_per_step(stacked, n, "int8")
+    assert bf16 / fp32 == 0.5
+    assert int8 / fp32 <= 0.3  # the acceptance bar: <= 0.3x on the wire
+    with pytest.raises(ValueError, match="unknown grad_comm"):
+        wire_bytes_per_step(stacked, n, "fp8")
+
+
+# -- config + registry refusals --------------------------------------------
+
+def test_config_refusals():
+    with pytest.raises(ValueError, match="unknown --grad_comm"):
+        TrainingConfig(grad_comm="fp16")
+    with pytest.raises(ValueError, match="replicated params"):
+        TrainingConfig(ddp_overlap=True, fsdp=True)
+    with pytest.raises(ValueError, match="replicated params"):
+        TrainingConfig(ddp_overlap=True, fsdp_overlap=True,
+                       scan_layers=True)
+    with pytest.raises(ValueError, match="only exists under --ddp_overlap"):
+        TrainingConfig(grad_comm="int8")
+    with pytest.raises(ValueError, match="no error to"):
+        TrainingConfig(ddp_overlap=True, scan_layers=True,
+                       grad_error_feedback=True)
+    with pytest.raises(ValueError, match="accumulation"):
+        TrainingConfig(ddp_overlap=True, scan_layers=True,
+                       grad_comm="int8", grad_error_feedback=True,
+                       gradient_accumulation_steps=2)
+
+
+def test_registry_refusals(devices):
+    mesh = make_mesh("data:-1")
+    with pytest.raises(ValueError, match="needs --scan_layers"):
+        build("gpt-tiny", TrainingConfig(model="gpt-tiny",
+                                         ddp_overlap=True), mesh=mesh)
+    with pytest.raises(ValueError, match="MoE"):
+        build("gpt-moe-tiny",
+              TrainingConfig(model="gpt-moe-tiny", scan_layers=True,
+                             ddp_overlap=True), mesh=mesh)
+    with pytest.raises(ValueError, match="GPipe pipeline"):
+        build("gpt-pipe-tiny",
+              TrainingConfig(model="gpt-pipe-tiny", scan_layers=True,
+                             ddp_overlap=True), mesh=mesh)
+    with pytest.raises(ValueError, match="no transformer layer stack"):
+        build("mlp", TrainingConfig(model="mlp", scan_layers=True,
+                                    ddp_overlap=True), mesh=mesh)
+    with pytest.raises(ValueError, match="data-parallel meshes only"):
+        build("gpt-tiny",
+              TrainingConfig(model="gpt-tiny", scan_layers=True,
+                             ddp_overlap=True, mesh="data:4,model:2"),
+              mesh=make_mesh("data:4,model:2"))
+
+
+# -- model-path parity -----------------------------------------------------
+
+def _pair(name, **overrides):
+    cfg_b = TrainingConfig(model=name, dataset_size=32, scan_layers=True)
+    cfg_o = TrainingConfig(model=name, dataset_size=32, scan_layers=True,
+                           ddp_overlap=True, **overrides)
+    mesh = make_mesh("data:-1")
+    task_b, ds = build(name, cfg_b, mesh=mesh)
+    task_o, _ = build(name, cfg_o, mesh=mesh)
+    batch = {k: jax.device_put(np.asarray(v),
+                               NamedSharding(mesh, P("data")))
+             for k, v in ds.batch(np.arange(8)).items()}
+    return task_b, task_o, batch, mesh
+
+
+@pytest.mark.slow  # ~20s of model jits; the scan/wire units above are the
+#                    tier-1 tripwire, this is the model-level pin
+def test_gpt_tiny_loss_and_grad_parity(devices):
+    """fp32 comms: loss and every grad leaf agree between the GSPMD
+    baseline scan and the per-layer-reduced path."""
+    task_b, task_o, batch, mesh = _pair("gpt-tiny")
+    assert task_o.model.ddp_overlap and task_o.model.mesh is mesh
+    key = jax.random.PRNGKey(0)
+    params, _ = task_b.init(key, batch)
+    params = nn.meta.unbox(params)
+
+    def loss_of(task):
+        def f(p):
+            loss, _, _ = task.loss(p, {}, batch, None, train=False)
+            return loss
+        return jax.jit(jax.value_and_grad(f))
+
+    lb, gb = loss_of(task_b)(params)
+    lo, go = loss_of(task_o)(params)
+    np.testing.assert_allclose(float(lb), float(lo), atol=TOL)
+    assert _max_abs_diff(gb, go) < TOL
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", ["gpt-tiny", "bert-tiny", "vit-tiny"])
+def test_engine_step_parity(name, devices):
+    """One full jitted optimizer step per family under --grad_comm fp32:
+    the per-layer-reduced path updates every weight to within TOL of the
+    GSPMD baseline. Dropout is cloned OFF (bert-tiny defaults 0.1): with
+    dropout active the paths draw per-layer streams differently by design
+    (the overlap path folds the layer index and data coordinate where
+    nn.scan splits) — statistically equivalent, documented in README, not
+    the math this test pins."""
+    from pytorch_ddp_template_tpu.parallel.sharding import shard_tree
+    from pytorch_ddp_template_tpu.train.engine import (
+        TrainState, make_optimizer, make_train_step,
+    )
+
+    task_b, task_o, batch, mesh = _pair(name)
+    task_b.model = task_b.model.clone(dropout_rate=0.0)
+    task_o.model = task_o.model.clone(dropout_rate=0.0)
+    cfg = TrainingConfig(model=name, warmup_steps=0)
+    key = jax.random.PRNGKey(0)
+    states, metrics = {}, {}
+    for tag, task in (("default", task_b), ("overlap", task_o)):
+        params, extra = task.init(key, batch)
+        tx, schedule = make_optimizer(cfg, total_steps=10)
+        state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                           extra_vars=extra, opt_state=tx.init(params),
+                           rng=jax.random.clone(key))
+        state = shard_tree(state, mesh)
+        step = make_train_step(task, tx, schedule)
+        states[tag], metrics[tag] = step(state, batch)
+    np.testing.assert_allclose(np.asarray(metrics["default"]["loss"]),
+                               np.asarray(metrics["overlap"]["loss"]),
+                               atol=TOL)
+    assert _max_abs_diff(states["default"].params,
+                         states["overlap"].params) < TOL
+
+
+@pytest.mark.slow
+def test_engine_step_int8_error_feedback(devices):
+    """Whole-engine int8+EF step: the residual rides TrainState, comes
+    back updated (non-zero) through the cotangent channel, the params
+    stay within quantization distance of the fp32-path update, and a
+    second step consumes the first step's residual."""
+    from pytorch_ddp_template_tpu.parallel.sharding import shard_tree
+    from pytorch_ddp_template_tpu.train.engine import (
+        TrainState, make_optimizer, make_train_step,
+    )
+
+    task_b, task_o, batch, mesh = _pair(
+        "gpt-tiny", grad_comm="int8", grad_error_feedback=True)
+    cfg = TrainingConfig(model="gpt-tiny", warmup_steps=0)
+    key = jax.random.PRNGKey(0)
+
+    def make_state(task):
+        params, extra = task.init(key, batch)
+        residual = (extra.pop("comm_residual", None)
+                    if isinstance(extra, dict) else None)
+        tx, schedule = make_optimizer(cfg, total_steps=10)
+        state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                           extra_vars=extra, opt_state=tx.init(params),
+                           rng=jax.random.clone(key),
+                           comm_residual=residual)
+        state = shard_tree(state, mesh)
+        if state.comm_residual is not None:
+            sh = NamedSharding(mesh, P(None, "data"))
+            state = state.replace(comm_residual=jax.tree.map(
+                lambda x: jax.device_put(x, sh), state.comm_residual))
+        return make_train_step(task, tx, schedule), state
+
+    step_b, state_b = make_state(task_b)
+    step_o, state_o = make_state(task_o)
+    assert state_b.comm_residual is None
+    assert state_o.comm_residual is not None
+    new_b, _ = step_b(state_b, batch)
+    new_o, m = step_o(state_o, batch)
+    assert np.isfinite(float(m["loss"]))
+    gap = _max_abs_diff(new_b.params, new_o.params)
+    assert 0 < gap < 1e-3  # compression ran; update stayed in its band
+    res_max = max(float(jnp.abs(l).max())
+                  for l in jax.tree.leaves(new_o.comm_residual))
+    assert res_max > 0
+    new_o2, m2 = step_o(new_o, batch)
+    assert np.isfinite(float(m2["loss"]))
+    # eval on the int8 model must not demand an rng (backward never runs)
+    ev_loss, _, _ = task_o.loss(new_o2.params, new_o2.extra_vars, batch,
+                                None, train=False)
+    assert np.isfinite(float(ev_loss))
+
+
+# -- checkpoint forward/backward compatibility -----------------------------
+
+def _tiny_state(with_residual: bool):
+    from pytorch_ddp_template_tpu.train.engine import TrainState
+
+    residual = {"layers": jnp.full((2, 4, 8), 0.25)} if with_residual else None
+    return TrainState(
+        step=jnp.asarray(3, jnp.int32),
+        params={"w": jnp.arange(6.0).reshape(2, 3)},
+        extra_vars={},
+        opt_state={"m": jnp.ones((2, 3))},
+        rng=jax.random.PRNGKey(0),
+        comm_residual=residual,
+    )
+
+
+class TestCheckpointResidualCompat:
+    def test_pre_residual_checkpoint_zero_inits_residual(self, tmp_path):
+        """Forward compat: a checkpoint written WITHOUT a residual (the
+        pre-r9 layout — saving with comm_residual=None produces exactly
+        it) restores into an error-feedback run with the residual
+        zero-initialised instead of crashing."""
+        from pytorch_ddp_template_tpu.checkpoint.manager import (
+            CheckpointManager,
+        )
+
+        ckpt = CheckpointManager(tmp_path / "ck")
+        ckpt.save(3, _tiny_state(False), TrainingConfig())
+        ckpt.wait()
+        template = _tiny_state(True).replace(
+            comm_residual={"layers": jnp.zeros((2, 4, 8))})
+        state, _ = ckpt.restore(None, template)
+        np.testing.assert_array_equal(
+            np.asarray(state.params["w"]),
+            np.arange(6.0).reshape(2, 3))
+        assert float(jnp.abs(state.comm_residual["layers"]).max()) == 0.0
+        ckpt.close()
+
+    def test_residual_checkpoint_roundtrip_and_ignored_when_off(
+            self, tmp_path):
+        """Backward compat both ways: an EF checkpoint restores its
+        residual values into an EF run, and restores cleanly (residual
+        ignored) into a run with error feedback off."""
+        from pytorch_ddp_template_tpu.checkpoint.manager import (
+            CheckpointManager,
+        )
+
+        ckpt = CheckpointManager(tmp_path / "ck")
+        ckpt.save(3, _tiny_state(True), TrainingConfig())
+        ckpt.wait()
+        # EF on: values round-trip
+        template = _tiny_state(True).replace(
+            comm_residual={"layers": jnp.zeros((2, 4, 8))})
+        state, _ = ckpt.restore(None, template)
+        np.testing.assert_allclose(
+            np.asarray(state.comm_residual["layers"]), 0.25)
+        # EF off: the residual item is never requested — no crash, None
+        state_off, _ = ckpt.restore(None, _tiny_state(False))
+        assert state_off.comm_residual is None
+        np.testing.assert_array_equal(
+            np.asarray(state_off.params["w"]),
+            np.arange(6.0).reshape(2, 3))
+        ckpt.close()
+
+    @pytest.mark.slow  # two Trainer builds + train-step compiles
+    def test_trainer_resume_across_ef_toggle(self, tmp_path):
+        """CLI-level: a run trained WITHOUT error feedback resumes into a
+        --grad_error_feedback run (zero residual) and trains on — the
+        restore path, template build and residual placement compose."""
+        from pytorch_ddp_template_tpu.runtime.context import RuntimeContext
+        from pytorch_ddp_template_tpu.train.engine import Trainer
+
+        mesh = make_mesh("data:-1")
+        key = jax.random.PRNGKey(0)
+
+        def trainer(**overrides):
+            kw = dict(
+                model="gpt-tiny", mesh="data:-1", dataset_size=64,
+                per_device_train_batch_size=1, max_steps=1,
+                logging_steps=0, save_steps=0, seed=0,
+                output_dir=str(tmp_path / "out"), scan_layers=True,
+                ddp_overlap=True)
+            kw.update(overrides)
+            cfg = TrainingConfig(**kw)
+            ctx = RuntimeContext(mesh=mesh, seed_key=key,
+                                 host_key=jax.random.fold_in(key, 0),
+                                 config=cfg)
+            task, ds = build(cfg.model, cfg, mesh=mesh)
+            return Trainer(cfg, ctx, task, ds)
+
+        t1 = trainer()
+        state = t1.train()
+        assert state.comm_residual is None
+        t1.ckpt.close()
+        t2 = trainer(grad_comm="int8", grad_error_feedback=True,
+                     max_steps=2)
+        state2, start = t2.restore_or_init()
+        assert start == 1
+        assert state2.comm_residual is not None
+        assert max(float(jnp.abs(l).max())
+                   for l in jax.tree.leaves(state2.comm_residual)) == 0.0
+        final = t2.train()
+        assert int(final.step) == 2
+        assert max(float(jnp.abs(l).max())
+                   for l in jax.tree.leaves(final.comm_residual)) > 0
+        t2.ckpt.close()

@@ -124,6 +124,8 @@ class TestPipelinedGptEntry:
                              host_key=jax.random.fold_in(key, 0), config=cfg)
         return cfg, ctx, task, ds
 
+    @pytest.mark.slow  # ~14s of stage-stacked jits; the schedule-level
+    # parity above and the clamp-warning tests below stay in tier-1
     def test_matches_sequential_blocks(self, tmp_path):
         """The pipelined forward must equal running the same block params
         sequentially (embed → layers in order → ln → tied head)."""
@@ -151,6 +153,8 @@ class TestPipelinedGptEntry:
                                    np.asarray(want, np.float32),
                                    rtol=1e-4, atol=1e-4)
 
+    @pytest.mark.slow  # ~39s whole-Trainer run; test_pipelined_entry_
+    # composes_with_fsdp keeps a Trainer-level pipe step in tier-1
     def test_trains_through_trainer_with_stage_sharding(self, tmp_path):
         from pytorch_ddp_template_tpu.train.engine import Trainer
 
@@ -177,6 +181,8 @@ class TestPipelinedGptEntry:
         with pytest.raises(ValueError, match="pipe axis"):
             task.init(jax.random.PRNGKey(0), batch)
 
+    @pytest.mark.slow  # ~17s deep grad-parity sweep (long-tail; the
+    # toy-stage grad test above pins the schedule's backward in tier-1)
     def test_gradients_match_sequential_with_data_axis(self, tmp_path):
         """pipe x data composition: with the microbatch dim sharded over
         ``data``, gradients of the pipelined loss must still equal the
@@ -217,6 +223,8 @@ class TestPipelinedGptEntry:
                 err_msg=str(path))
 
 
+@pytest.mark.slow  # ~20s two-Trainer save/resume cycle; generic resume is
+# tier-1-covered by test_fault_recovery on the dense entries
 def test_pipelined_entry_checkpoint_resume(tmp_path):
     """The stacked (pipe-sharded, Partitioned-annotated) stage params must
     survive an orbax save/restore and continue training — the stacked
@@ -286,3 +294,55 @@ def test_pipelined_entry_composes_with_fsdp(tmp_path):
     assert any("data" in s for s in specs)  # the ZeRO-3 split landed
     state, metrics = t.train_step(state, next(iter(t.loader.epoch(0))))
     assert np.isfinite(float(metrics["loss"]))
+
+
+class TestMicrobatchClampWarning:
+    """The r6 microbatch-clamp warning (models/gpt_pipe.py): a coprime
+    --pipe_microbatches / per-replica-batch pair silently serialises the
+    pipeline, so the task must say so — once — at trace time, and stay
+    silent when the count divides."""
+
+    def _records_of(self, n_micro, batch):
+        import logging
+
+        from pytorch_ddp_template_tpu.config import TrainingConfig
+        from pytorch_ddp_template_tpu.models import build
+
+        cfg = TrainingConfig(model="gpt-pipe-tiny", mesh="data:4,pipe:2",
+                             pipe_microbatches=n_micro)
+        mesh = make_mesh(cfg.mesh, jax.devices())
+        task, _ = build(cfg.model, cfg, mesh=mesh)
+        params, _ = task.init(jax.random.PRNGKey(0), batch)
+        # the module logger does not propagate (utils/logging.py), so
+        # capture with a handler attached directly to it
+        records: list[logging.LogRecord] = []
+
+        class Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record)
+
+        log = logging.getLogger("pytorch_ddp_template_tpu.models.gpt_pipe")
+        handler = Capture()
+        log.addHandler(handler)
+        try:
+            import flax.linen as nn
+
+            for _ in range(2):  # twice: the warning must fire ONCE
+                task._apply_inputs(nn.meta.unbox(params), {},
+                                   (jnp.asarray(batch["input_ids"]),),
+                                   None, False)
+        finally:
+            log.removeHandler(handler)
+        return [r for r in records if "clamped" in r.getMessage()]
+
+    def test_warns_once_when_coprime(self):
+        # per-replica batch = 8/4 = 2; gcd(3, 2) = 1 < 3 -> clamped
+        batch = {"input_ids": np.zeros((8, 128), np.int32)}
+        warned = self._records_of(3, batch)
+        assert len(warned) == 1
+        assert warned[0].levelname == "WARNING"
+
+    def test_silent_when_dividing(self):
+        # gcd(2, 2) = 2 == requested -> no clamp, no warning
+        batch = {"input_ids": np.zeros((8, 128), np.int32)}
+        assert self._records_of(2, batch) == []
