@@ -100,6 +100,18 @@ class TrainingConfig:
     #                                    lineage): the quantization error
     #                                    telescopes instead of random-
     #                                    walking. Needs a lossy --grad_comm
+    tp_overlap: bool = False  # decomposed tensor-parallel collective
+    #                           matmuls (parallel/collective_matmul.py):
+    #                           the scanned stack's Megatron matmuls run
+    #                           as ring all-gather-matmul (fc1/fused-qkv)
+    #                           and matmul-reduce-scatter (fc2/out)
+    #                           shard_map regions over the `model` axis —
+    #                           single-hop ppermutes hide under partial
+    #                           dots instead of GSPMD's blocking psum/
+    #                           all-gather walls; the model-sharded LM
+    #                           head rides the same ring (ops/lm_head.py).
+    #                           Needs --scan_layers and a `model` mesh
+    #                           axis; MoE/pipe/--ddp_overlap/--fsdp refused
     remat: bool = False  # rematerialise blocks (peak-memory for FLOPs trade;
     #                      long-context entries default it on regardless)
     scan_layers: bool = False  # drive the transformer block stack as ONE
@@ -173,6 +185,24 @@ class TrainingConfig:
                 "--grad_error_feedback compensates lossy gradient "
                 "compression; with --grad_comm fp32 there is no error to "
                 "feed back — pass --grad_comm bf16|int8 or drop the flag"
+            )
+        if self.tp_overlap and not self.scan_layers:
+            raise ValueError(
+                "--tp_overlap needs --scan_layers: the ring-decomposed "
+                "block is compiled once and driven over the stacked "
+                "(num_layers, ...) weights; pass both flags"
+            )
+        if self.tp_overlap and self.ddp_overlap:
+            raise ValueError(
+                "--tp_overlap cannot compose with --ddp_overlap: each "
+                "mode owns the stack's execution schedule (model-axis "
+                "rings vs per-layer data-axis reduces); pick one"
+            )
+        if self.tp_overlap and self.fsdp:
+            raise ValueError(
+                "--tp_overlap assumes weights sharded over `model` only; "
+                "--fsdp/--fsdp_overlap adds a data-axis split the ring "
+                "region specs cannot serve — pick one execution mode"
             )
         if self.grad_error_feedback and self.gradient_accumulation_steps > 1:
             raise ValueError(
@@ -333,6 +363,22 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "a different topology or from a pre-residual "
                         "checkpoint zero-initialises them (fresh runs "
                         "recommended when changing comm settings).")
+    p.add_argument("--tp_overlap", action="store_true",
+                   help="Decomposed tensor-parallel collective matmuls "
+                        "(parallel/collective_matmul.py): the scanned "
+                        "stack's Megatron matmuls run as ring collectives "
+                        "over the `model` mesh axis — all-gather-matmul "
+                        "for column-split fc1/fused-qkv (each activation "
+                        "chunk's partial dot hides the next chunk's "
+                        "single-hop ppermute), matmul-reduce-scatter for "
+                        "row-split fc2/out (partials reduce around the "
+                        "ring; no blocking psum), with hand-written "
+                        "backwards pipelining the transposed collectives. "
+                        "The model-sharded LM head accumulates per-shard "
+                        "partial logits around the same ring (fused_head "
+                        "is turned on for LM families). Requires "
+                        "--scan_layers and a model:N mesh axis; MoE/pipe/"
+                        "--ddp_overlap/--fsdp refused.")
     p.add_argument("--fused_head", action="store_true",
                    help="Compute the LM head blockwise over the vocab "
                         "(ops/lm_head.py): the (B,T,V) logits tensor never "

@@ -53,6 +53,10 @@ class BertEncoder(nn.Module):
     ddp_overlap: bool = False
     grad_comm: str = "fp32"
     grad_error_feedback: bool = False
+    # ring-decomposed TP collective matmuls (--tp_overlap,
+    # parallel/collective_matmul.py); the tied MLM head rides the same
+    # ring (ops/lm_head.tp_lm_head_loss). Needs scan_layers + data×model
+    tp_overlap: bool = False
     # blockwise tied MLM head (ops/lm_head.py): return the transformed
     # head hidden states; the task applies table+bias vocab-block-wise,
     # so the (B, T, V) logits tensor never exists
@@ -91,6 +95,7 @@ class BertEncoder(nn.Module):
             ddp_overlap=self.ddp_overlap,
             grad_comm=self.grad_comm,
             grad_error_feedback=self.grad_error_feedback,
+            tp_overlap=self.tp_overlap,
             name="encoder",
         )
         self.mlm_ln = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")
@@ -175,7 +180,9 @@ class MlmTask(Task):
         if getattr(self.model, "fused_head", False):
             token_logp, hits = self.blockwise_head(
                 out, params["word_embeddings"]["embedding"], targets,
-                bias=params["mlm_bias"])
+                bias=params["mlm_bias"],
+                mesh=self.model.mesh if getattr(
+                    self.model, "tp_overlap", False) else None)
         else:
             logp = jax.nn.log_softmax(out, axis=-1)
             token_logp = jnp.take_along_axis(

@@ -53,6 +53,13 @@ class GptDecoder(nn.Module):
     ddp_overlap: bool = False
     grad_comm: str = "fp32"
     grad_error_feedback: bool = False
+    # ring-decomposed TP collective matmuls (--tp_overlap,
+    # parallel/collective_matmul.py): qkv/fc1 as all-gather-matmul rings,
+    # out/fc2 as matmul-reduce-scatter rings over the `model` axis; the
+    # tied LM head accumulates per-vocab-shard partial logits around the
+    # same ring (ops/lm_head.tp_lm_head_loss). Needs scan_layers + a
+    # data×model mesh; registry turns fused_head on alongside
+    tp_overlap: bool = False
     # blockwise tied head (ops/lm_head.py): the model returns final hidden
     # states and the task computes cross-entropy vocab-block-wise — the
     # (B, T, V) logits tensor never exists. The memory enabler for the
@@ -94,6 +101,7 @@ class GptDecoder(nn.Module):
             ddp_overlap=self.ddp_overlap,
             grad_comm=self.grad_comm,
             grad_error_feedback=self.grad_error_feedback,
+            tp_overlap=self.tp_overlap,
             name="decoder",
         )(x, train=train)
         x = nn.LayerNorm(dtype=jnp.float32, name="final_ln")(x)
@@ -121,9 +129,13 @@ class CausalLmTask(Task):
         targets = input_ids[:, 1:].astype(jnp.int32)
         if getattr(self.model, "fused_head", False):
             # ``out`` is final hidden states; head computed blockwise
-            # against the tied table (ops/lm_head.py) — no (B,T,V) logits
+            # against the tied table (ops/lm_head.py) — no (B,T,V) logits.
+            # Under --tp_overlap the vocab shards stay put and the hidden
+            # chunks ring past them (tp_lm_head_loss)
             token_logp, hits = self.blockwise_head(
-                out[:, :-1], params["wte"]["embedding"], targets)
+                out[:, :-1], params["wte"]["embedding"], targets,
+                mesh=self.model.mesh if getattr(
+                    self.model, "tp_overlap", False) else None)
         else:
             logp = jax.nn.log_softmax(out[:, :-1], axis=-1)
             token_logp = jnp.take_along_axis(

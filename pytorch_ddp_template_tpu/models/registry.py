@@ -147,6 +147,36 @@ def build(name: str, config: TrainingConfig, mesh=None) -> tuple[Task, Dataset]:
         task.model = task.model.clone(
             ddp_overlap=True, mesh=mesh, grad_comm=config.grad_comm,
             grad_error_feedback=config.grad_error_feedback)
+    if config.tp_overlap:
+        # --scan_layers is co-required by config.__post_init__; this path
+        # also covers direct TrainingConfig construction with both set
+        if not hasattr(task.model, "tp_overlap"):
+            raise ValueError(
+                f"--tp_overlap: model {name!r} "
+                f"({type(task.model).__name__}) has no tensor-parallel "
+                "transformer stack to decompose (transformer families "
+                "only)"
+            )
+        if getattr(task.model, "moe_experts", 0):
+            raise ValueError(
+                "--tp_overlap does not compose with MoE entries yet (the "
+                "expert dispatch needs in-region handling); drop one of "
+                "the two"
+            )
+        from ..parallel.collective_matmul import validate_tp_mesh
+        from ..runtime import make_mesh
+
+        import jax
+
+        if mesh is None:
+            mesh = make_mesh(config.mesh, jax.devices())
+        validate_tp_mesh(mesh)  # fail fast, before any tracing
+        kwargs = {"tp_overlap": True, "mesh": mesh}
+        if hasattr(task.model, "fused_head"):
+            # the ring vocab head IS the LM head under --tp_overlap: the
+            # (B,T,V) logits tensor must never materialise on any shard
+            kwargs["fused_head"] = True
+        task.model = task.model.clone(**kwargs)
     if config.data_dir:
         from ..data.filestore import MemmapDataset
 

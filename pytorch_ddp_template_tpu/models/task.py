@@ -74,16 +74,28 @@ class Task:
     #: vocab tile width for fused_head LM models (ops/lm_head.py)
     head_block = 8192
 
-    def blockwise_head(self, hidden, table, targets, bias=None):
+    def blockwise_head(self, hidden, table, targets, bias=None, mesh=None):
         """``(token_logp, hits)`` via the blockwise LM head — the shared
         fused-head path of the LM tasks (gpt/bert). ``table``/``bias`` may
-        arrive boxed (``nn.Partitioned``) straight from init."""
-        from ..ops.lm_head import lm_head_loss
+        arrive boxed (``nn.Partitioned``) straight from init.
+
+        ``mesh`` (the ``--tp_overlap`` path) routes through the ring-
+        decomposed TP head instead: the ``model``-sharded vocab table
+        stays put and (hidden-chunk, online-stats) bundles rotate past it
+        (``ops/lm_head.tp_lm_head_loss``) — same never-materialised
+        (B, T, V) contract, gather/psum overlapped with the logit dots."""
+        from ..ops.lm_head import lm_head_loss, tp_lm_head_loss
 
         table = nn.meta.unbox(table)
         bias = None if bias is None else nn.meta.unbox(bias)
-        token_logp, pred = lm_head_loss(hidden, table, targets, bias=bias,
-                                        block=self.head_block)
+        if mesh is not None:
+            token_logp, pred = tp_lm_head_loss(hidden, table, targets, mesh,
+                                               bias=bias,
+                                               block=self.head_block)
+        else:
+            token_logp, pred = lm_head_loss(hidden, table, targets,
+                                            bias=bias,
+                                            block=self.head_block)
         return token_logp, (pred == targets).astype(jnp.float32)
 
     @staticmethod

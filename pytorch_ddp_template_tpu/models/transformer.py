@@ -16,7 +16,8 @@ BERT-base MLM and ViT-B/16, which share this encoder. TPU-first choices:
 
 from __future__ import annotations
 
-from typing import Callable
+import math
+from typing import Any, Callable
 
 import flax.linen as nn
 import jax
@@ -47,6 +48,62 @@ def _dense(features, dtype, name, logical_axes, kernel_init=None):
     )
 
 
+class _DenseParams(nn.Module):
+    """Parameter-tree twin of an ``nn.DenseGeneral``: declares the same
+    ``kernel``/``bias`` params (names, shapes, init streams, logical axes)
+    under the same submodule name, but returns them instead of applying
+    the matmul — so ``--tp_overlap`` can route the compute through the
+    ring-decomposed collective matmuls (``parallel/collective_matmul.py``)
+    while checkpoints and ``Task.init`` stay bit-interchangeable with the
+    GSPMD-default path. ``in_features`` are the contraction dims, raw
+    (unflattened), exactly as DenseGeneral stores them."""
+
+    in_features: tuple[int, ...]
+    features: tuple[int, ...]
+    logical_axes: tuple
+    kernel_init: Any = None
+
+    @nn.compact
+    def __call__(self):
+        inner = self.kernel_init or default_kernel_init
+        n_in = len(self.in_features)
+
+        def flat_init(rng, shape, dtype=jnp.float32):
+            # DenseGeneral's kernel_init_wrap: the initializer sees the
+            # flattened 2D (fan_in, fan_out) shape, so fan-dependent
+            # inits (lecun/he/...) draw the same values as the GSPMD
+            # path, not just the shape-invariant default
+            flat = (math.prod(shape[:n_in]), math.prod(shape[n_in:]))
+            return jnp.reshape(inner(rng, flat, dtype), shape)
+
+        kernel = self.param(
+            "kernel",
+            nn.with_logical_partitioning(flat_init, self.logical_axes),
+            self.in_features + self.features, jnp.float32,
+        )
+        bias = self.param(
+            "bias",
+            nn.with_logical_partitioning(
+                nn.initializers.zeros,
+                self.logical_axes[len(self.in_features):],
+            ),
+            self.features, jnp.float32,
+        )
+        return kernel, bias
+
+
+def _plain_dense(x, kernel, bias, n_axes: int, dtype):
+    """DenseGeneral's contraction, applied directly — the init-time path
+    of the TP-overlapped layers (shapes/params only; init never needs the
+    ring schedule) and the reference semantics the ring ops reproduce."""
+    x = x.astype(dtype)
+    kernel = kernel.astype(dtype)
+    axes = tuple(range(x.ndim - n_axes, x.ndim))
+    kaxes = tuple(range(n_axes))
+    y = jax.lax.dot_general(x, kernel, ((axes, kaxes), ((), ())))
+    return y + bias.astype(dtype)
+
+
 class MultiHeadAttention(nn.Module):
     """Self-attention with fused-qkv-friendly layout and op dispatch.
 
@@ -62,24 +119,63 @@ class MultiHeadAttention(nn.Module):
     attn_impl: str = "auto"  # Impl | "ring"
     mesh: jax.sharding.Mesh | None = None
     causal: bool = False
+    # ring-decomposed TP matmuls (--tp_overlap): qkv becomes ONE fused
+    # all-gather-matmul ring (the activation rotates once for all three
+    # projections) and the out projection a matmul-reduce-scatter ring
+    # (parallel/collective_matmul.py); param tree unchanged
+    tp_overlap: bool = False
+
+    def _tp_qkv(self, x):
+        from ..parallel.collective_matmul import tp_column_dense
+
+        embed = x.shape[-1]
+        params = [
+            _DenseParams((embed,), (self.num_heads, self.head_dim),
+                         ("embed", "heads", "kv"), name=name)()
+            for name in ("query", "key", "value")
+        ]
+        kernels = [k for k, _ in params]
+        biases = [b for _, b in params]
+        if self.is_initializing():
+            return [_plain_dense(x, k, b, 1, self.dtype)
+                    for k, b in params]
+        x = x.astype(self.dtype)
+        return tp_column_dense(
+            x, [k.astype(self.dtype) for k in kernels],
+            [b.astype(self.dtype) for b in biases], self.mesh)
+
+    def _tp_out(self, out, features):
+        from ..parallel.collective_matmul import tp_row_dense
+
+        kernel, bias = _DenseParams(
+            (self.num_heads, self.head_dim), (features,),
+            ("heads", "kv", "embed"), name="out")()
+        if self.is_initializing():
+            return _plain_dense(out, kernel, bias, 2, self.dtype)
+        return tp_row_dense(out.astype(self.dtype),
+                            kernel.astype(self.dtype),
+                            bias.astype(self.dtype), self.mesh)
 
     @nn.compact
     def __call__(self, x, mask=None, *, train: bool = True):
         features = x.shape[-1]
-        proj = lambda name: nn.DenseGeneral(
-            (self.num_heads, self.head_dim),
-            dtype=self.dtype,
-            kernel_init=nn.with_logical_partitioning(
-                default_kernel_init, ("embed", "heads", "kv")
-            ),
-            bias_init=nn.with_logical_partitioning(
-                nn.initializers.zeros, ("heads", "kv")
-            ),
-            name=name,
-        )
-        q = proj("query")(x)
-        k = proj("key")(x)
-        v = proj("value")(x)
+        if self.tp_overlap:
+            q, k, v = self._tp_qkv(x)
+        else:
+            proj = lambda name: nn.DenseGeneral(
+                (self.num_heads, self.head_dim),
+                dtype=self.dtype,
+                kernel_init=nn.with_logical_partitioning(
+                    default_kernel_init, ("embed", "heads", "kv")
+                ),
+                bias_init=nn.with_logical_partitioning(
+                    nn.initializers.zeros, ("heads", "kv")
+                ),
+                name=name,
+            )
+            q = proj("query")(x)
+            k = proj("key")(x)
+            v = proj("value")(x)
         if self.attn_impl in ("ring", "ulysses"):
             if self.mesh is None:
                 raise ValueError(f"attn_impl={self.attn_impl!r} requires mesh")
@@ -105,37 +201,72 @@ class MultiHeadAttention(nn.Module):
         else:
             out = attention(q, k, v, mask=mask, causal=self.causal,
                             impl=self.attn_impl)
-        out = nn.DenseGeneral(
-            features,
-            axis=(-2, -1),
-            dtype=self.dtype,
-            kernel_init=nn.with_logical_partitioning(
-                default_kernel_init, ("heads", "kv", "embed")
-            ),
-            bias_init=nn.with_logical_partitioning(
-                nn.initializers.zeros, ("embed",)
-            ),
-            name="out",
-        )(out)
+        if self.tp_overlap:
+            out = self._tp_out(out, features)
+        else:
+            out = nn.DenseGeneral(
+                features,
+                axis=(-2, -1),
+                dtype=self.dtype,
+                kernel_init=nn.with_logical_partitioning(
+                    default_kernel_init, ("heads", "kv", "embed")
+                ),
+                bias_init=nn.with_logical_partitioning(
+                    nn.initializers.zeros, ("embed",)
+                ),
+                name="out",
+            )(out)
         if self.dropout_rate:
             out = nn.Dropout(self.dropout_rate, deterministic=not train)(out)
         return out
 
 
 class MlpBlock(nn.Module):
-    """Position-wise feed-forward; hidden dim shards over ``mlp``."""
+    """Position-wise feed-forward; hidden dim shards over ``mlp``.
+
+    Under ``tp_overlap`` the two matmuls ride the ring-decomposed TP
+    collectives: fc1 as an all-gather-matmul consuming seq-sharded
+    activations chunk by chunk, fc2 as a matmul-reduce-scatter whose
+    partial products reduce around the ring (the gelu between them is
+    token-local and runs at the GSPMD level on the feature-sharded
+    hidden). Param tree identical to the DenseGeneral path."""
 
     mlp_dim: int
     dtype: jnp.dtype = jnp.float32
     dropout_rate: float = 0.0
     act: Callable = nn.gelu
+    tp_overlap: bool = False
+    mesh: jax.sharding.Mesh | None = None
 
     @nn.compact
     def __call__(self, x, *, train: bool = True):
         features = x.shape[-1]
-        h = _dense(self.mlp_dim, self.dtype, "fc1", ("embed", "mlp"))(x)
-        h = self.act(h)
-        h = _dense(features, self.dtype, "fc2", ("mlp", "embed"))(h)
+        if self.tp_overlap:
+            from ..parallel.collective_matmul import (
+                tp_column_dense, tp_row_dense,
+            )
+
+            k1, b1 = _DenseParams((features,), (self.mlp_dim,),
+                                  ("embed", "mlp"), name="fc1")()
+            if self.is_initializing():
+                h = _plain_dense(x, k1, b1, 1, self.dtype)
+            else:
+                (h,) = tp_column_dense(
+                    x.astype(self.dtype), [k1.astype(self.dtype)],
+                    [b1.astype(self.dtype)], self.mesh)
+            h = self.act(h)
+            k2, b2 = _DenseParams((self.mlp_dim,), (features,),
+                                  ("mlp", "embed"), name="fc2")()
+            if self.is_initializing():
+                h = _plain_dense(h, k2, b2, 1, self.dtype)
+            else:
+                h = tp_row_dense(h.astype(self.dtype),
+                                 k2.astype(self.dtype),
+                                 b2.astype(self.dtype), self.mesh)
+        else:
+            h = _dense(self.mlp_dim, self.dtype, "fc1", ("embed", "mlp"))(x)
+            h = self.act(h)
+            h = _dense(features, self.dtype, "fc2", ("mlp", "embed"))(h)
         if self.dropout_rate:
             h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
         return h
@@ -154,6 +285,7 @@ class EncoderBlock(nn.Module):
     mesh: jax.sharding.Mesh | None = None
     causal: bool = False
     moe_experts: int = 0  # >0: FFN = top-1 MoE over this many experts
+    tp_overlap: bool = False  # ring-decomposed TP matmuls (qkv/out/fc1/fc2)
 
     @nn.compact
     def __call__(self, x, mask=None, train: bool = True):
@@ -163,6 +295,7 @@ class EncoderBlock(nn.Module):
         attn = MultiHeadAttention(
             self.num_heads, self.head_dim, self.dtype,
             self.dropout_rate, self.attn_impl, self.mesh, self.causal,
+            tp_overlap=self.tp_overlap,
             name="attention",
         )
         if self.moe_experts:
@@ -173,6 +306,7 @@ class EncoderBlock(nn.Module):
                               name="mlp")
         else:
             mlp = MlpBlock(self.mlp_dim, self.dtype, self.dropout_rate,
+                           tp_overlap=self.tp_overlap, mesh=self.mesh,
                            name="mlp")
         if self.pre_norm:
             x = x + attn(ln("ln_attn")(x).astype(self.dtype), mask, train=train)
@@ -234,6 +368,55 @@ class TransformerEncoder(nn.Module):
     ddp_overlap: bool = False
     grad_comm: str = "fp32"
     grad_error_feedback: bool = False
+    # decomposed tensor-parallel collective matmuls (--tp_overlap,
+    # parallel/collective_matmul.py): inside the scanned stack the
+    # Megatron matmuls become ring all-gather-matmul (fc1/fused-qkv) and
+    # matmul-reduce-scatter (fc2/out) shard_map regions over the `model`
+    # axis, with activations sequence-sharded over `model` between them;
+    # hand-written custom_vjps pipeline the transposed collectives the
+    # same way. Requires scan_layers and a data×model mesh; MoE and the
+    # other overlap modes refused with intent.
+    tp_overlap: bool = False
+
+    def _validate_tp(self, x) -> None:
+        from ..parallel.collective_matmul import (
+            validate_tp_mesh, _check_divisible,
+        )
+
+        from ..runtime.context import MODEL_AXIS
+
+        # Task.init drives the unrolled twin (scan_layers=False clone) for
+        # bit-interchangeable param stacking — the scan requirement binds
+        # at apply time only
+        if not self.scan_layers and not self.is_initializing():
+            raise ValueError(
+                "--tp_overlap needs --scan_layers: the ring-decomposed "
+                "block is compiled once and driven over the stacked "
+                "layers; pass both flags"
+            )
+        if self.moe_experts:
+            raise ValueError(
+                "--tp_overlap does not compose with MoE blocks yet (the "
+                "expert dispatch needs in-region handling); drop one of "
+                "the two"
+            )
+        if self.fsdp_overlap or self.ddp_overlap:
+            raise ValueError(
+                "--tp_overlap cannot compose with --fsdp_overlap/"
+                "--ddp_overlap: each mode owns the stack's execution "
+                "schedule; pick one"
+            )
+        if self.attn_impl in ("ring", "ulysses"):
+            raise ValueError(
+                "--tp_overlap does not compose with context-parallel "
+                f"attention (attn_impl={self.attn_impl!r} needs a 'seq' "
+                "mesh axis the TP rings refuse); drop one of the two"
+            )
+        validate_tp_mesh(self.mesh)
+        n = self.mesh.shape[MODEL_AXIS]
+        _check_divisible("sequence length", x.shape[1], n)
+        _check_divisible("num_heads", self.num_heads, n)
+        _check_divisible("mlp_dim", self.mlp_dim, n)
 
     @property
     def _ef_active(self) -> bool:
@@ -414,6 +597,8 @@ class TransformerEncoder(nn.Module):
 
     @nn.compact
     def __call__(self, x, mask=None, *, train: bool = True):
+        if self.tp_overlap:
+            self._validate_tp(x)
         block_cls = EncoderBlock
         if self.remat:
             block_cls = nn.remat(EncoderBlock, static_argnums=(3,))
@@ -427,6 +612,7 @@ class TransformerEncoder(nn.Module):
                 self.num_heads, self.head_dim, self.mlp_dim, self.dtype,
                 self.dropout_rate, self.pre_norm, self.attn_impl, self.mesh,
                 self.causal, moe_experts=self.moe_experts,
+                tp_overlap=self.tp_overlap,
                 name=SCAN_LAYER_AXIS,
             )
 
@@ -458,6 +644,7 @@ class TransformerEncoder(nn.Module):
                 self.num_heads, self.head_dim, self.mlp_dim, self.dtype,
                 self.dropout_rate, self.pre_norm, self.attn_impl, self.mesh,
                 self.causal, moe_experts=self.moe_experts,
+                tp_overlap=self.tp_overlap,
                 name=f"layer_{layer}",
             )
             x = block(x, mask, train) if self.remat else block(

@@ -48,6 +48,11 @@ class VisionTransformer(nn.Module):
     ddp_overlap: bool = False
     grad_comm: str = "fp32"
     grad_error_feedback: bool = False
+    # ring-decomposed TP collective matmuls (--tp_overlap). Note: ViT
+    # token counts (patches + cls) are rarely divisible by a model-axis
+    # size — the encoder's divisibility check refuses such geometries
+    # with the exact numbers rather than an opaque shard_map error.
+    tp_overlap: bool = False
     mesh: Any = None
 
     @nn.compact
@@ -99,6 +104,7 @@ class VisionTransformer(nn.Module):
             ddp_overlap=self.ddp_overlap,
             grad_comm=self.grad_comm,
             grad_error_feedback=self.grad_error_feedback,
+            tp_overlap=self.tp_overlap,
             name="encoder",
         )(x, train=train)
 

@@ -38,11 +38,15 @@ def _num_blocks(vocab: int, block: int) -> int:
     return -(-vocab // block)
 
 
-def _block_logits(hidden, table, bias, step, *, block: int, vocab: int):
+def _block_logits(hidden, table, bias, step, *, block: int, vocab: int,
+                  offset=0):
     """f32 logits for vocab block ``step`` with padded rows at -inf.
 
     ``table``/``bias`` are pre-padded to ``n_blocks * block`` rows; padded
     logits are masked so they contribute nothing to logsumexp or argmax.
+    ``offset`` is the absolute vocab id of ``table``'s row 0 — 0 for the
+    single-table path, ``shard * shard_rows`` for the TP ring head whose
+    local table is one ``model``-axis shard of the padded global table.
     """
     tb = lax.dynamic_slice_in_dim(table, step * block, block, axis=0)
     logits = lax.dot_general(
@@ -52,8 +56,48 @@ def _block_logits(hidden, table, bias, step, *, block: int, vocab: int):
     )  # (..., block)
     logits = logits + lax.dynamic_slice_in_dim(
         bias, step * block, block, axis=0).astype(jnp.float32)
-    v_ids = step * block + lax.iota(jnp.int32, block)
+    v_ids = offset + step * block + lax.iota(jnp.int32, block)
     return jnp.where(v_ids < vocab, logits, NEG_INF), tb
+
+
+def _online_step(carry, logits, v0, targets, block: int):
+    """One online-logsumexp/label/argmax update for a logits block whose
+    absolute vocab ids are ``[v0, v0 + block)``.
+
+    Shared between the single-table scan (``v0 = step * block``) and the
+    TP ring head (``v0 = shard_offset + step * block``, ops visited in
+    ring order). Argmax ties break toward the LOWEST absolute id
+    regardless of visit order, so both paths pick identical predictions.
+    """
+    m, l, label, best_v, best_i = carry
+    # online logsumexp
+    bm = jnp.max(logits, axis=-1)
+    m_new = jnp.maximum(m, bm)
+    l = l * jnp.exp(m - m_new) + jnp.sum(
+        jnp.exp(logits - m_new[..., None]), axis=-1)
+    # the target token's logit, when it falls in this block
+    in_blk = (targets >= v0) & (targets < v0 + block)
+    idx = jnp.clip(targets - v0, 0, block - 1)
+    val = jnp.take_along_axis(logits, idx[..., None], axis=-1)[..., 0]
+    label = jnp.where(in_blk, val, label)
+    # running argmax; lowest-id wins ties (visit-order invariant)
+    bi = jnp.argmax(logits, axis=-1)
+    bv = jnp.take_along_axis(logits, bi[..., None], axis=-1)[..., 0]
+    cand = v0 + bi
+    take = (bv > best_v) | ((bv == best_v) & (cand < best_i))
+    best_v = jnp.where(take, bv, best_v)
+    best_i = jnp.where(take, cand, best_i)
+    return m_new, l, label, best_v, best_i
+
+
+def _online_init(shape):
+    return (
+        jnp.full(shape, NEG_INF, jnp.float32),  # m
+        jnp.zeros(shape, jnp.float32),          # l
+        jnp.zeros(shape, jnp.float32),          # label logit
+        jnp.full(shape, NEG_INF, jnp.float32),  # best value
+        jnp.zeros(shape, jnp.int32),            # best index
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
@@ -67,35 +111,12 @@ def _fwd(hidden, table, bias, targets, block, vocab):
     shape = targets.shape  # (...,) token positions
 
     def body(carry, step):
-        m, l, label, best_v, best_i = carry
         logits, _ = _block_logits(hidden, table, bias, step,
                                   block=block, vocab=vocab)
-        # online logsumexp
-        bm = jnp.max(logits, axis=-1)
-        m_new = jnp.maximum(m, bm)
-        l = l * jnp.exp(m - m_new) + jnp.sum(
-            jnp.exp(logits - m_new[..., None]), axis=-1)
-        # the target token's logit, when it falls in this block
-        in_blk = (targets >= step * block) & (targets < step * block + block)
-        idx = jnp.clip(targets - step * block, 0, block - 1)
-        val = jnp.take_along_axis(logits, idx[..., None], axis=-1)[..., 0]
-        label = jnp.where(in_blk, val, label)
-        # running argmax for the accuracy metric
-        bi = jnp.argmax(logits, axis=-1)
-        bv = jnp.take_along_axis(logits, bi[..., None], axis=-1)[..., 0]
-        take = bv > best_v
-        best_v = jnp.where(take, bv, best_v)
-        best_i = jnp.where(take, step * block + bi, best_i)
-        return (m_new, l, label, best_v, best_i), None
+        return _online_step(carry, logits, step * block, targets, block), None
 
-    init = (
-        jnp.full(shape, NEG_INF, jnp.float32),  # m
-        jnp.zeros(shape, jnp.float32),          # l
-        jnp.zeros(shape, jnp.float32),          # label logit
-        jnp.full(shape, NEG_INF, jnp.float32),  # best value
-        jnp.zeros(shape, jnp.int32),            # best index
-    )
-    (m, l, label, _, best_i), _ = lax.scan(body, init, jnp.arange(n))
+    (m, l, label, _, best_i), _ = lax.scan(body, _online_init(shape),
+                                           jnp.arange(n))
     lse = m + jnp.log(jnp.maximum(l, 1e-30))
     token_logp = label - lse
     return (token_logp, best_i), (hidden, table, bias, targets, lse)
@@ -170,3 +191,204 @@ def lm_head_loss(hidden, table, targets, *, bias=None, block: int = 8192):
         bias = jnp.pad(bias, (0, pad))
     return blockwise_lm_head(hidden, table, bias,
                              targets.astype(jnp.int32), block, vocab)
+
+
+# -- TP ring head (--tp_overlap): model-sharded vocab, rotating stats ------
+#
+# With the vocab table sharded over the ``model`` mesh axis (the
+# parallel/sharding.py "vocab" rule), the GSPMD-default blockwise head
+# either all-gathers the table or psums per-block partial stats — one
+# blocking collective per vocab block, serialised against the logit dots.
+# Here each (hidden-chunk, targets, online-stats) bundle rotates around
+# the model ring (parallel/ring.py machinery, rotate-at-start): every
+# device folds its LOCAL vocab shard's blockwise logits into the visiting
+# bundle's logsumexp/label/argmax state, and after n hops the chunk is
+# home with complete stats — the (B, T, V) logits tensor never exists on
+# any device, and the single-hop ppermute (whose operands are loop-carried
+# only) hides under each step's logit dots. The backward rotates
+# (hidden, targets, gy, lse, dhidden-accumulator): each device drains its
+# dtable/dbias shard contribution as the chunks pass, and dhidden arrives
+# home fully accumulated — the transposed gather/psum pipelined the same
+# way (the hand-written-vjp discipline of parallel/overlap.py).
+
+
+def _tp_pad_seq(x, n, axis=1):
+    t = x.shape[axis]
+    pad = (-t) % n
+    if pad:
+        widths = [(0, 0)] * x.ndim
+        widths[axis] = (0, pad)
+        x = jnp.pad(x, widths)
+    return x, t
+
+
+def _tp_head_fwd_local(h, tgt, tab, bs, block, vocab):
+    """Per-shard forward: rotate the (hidden-chunk, targets, online-stats)
+    bundle around the model ring; each visit folds the LOCAL vocab
+    shard's blockwise logits into the visiting chunk's state. After n
+    hops the chunk is home with complete stats. Returns
+    ``(token_logp, argmax, lse)`` for the home chunk."""
+    from ..parallel.ring import axis_size, ring_perm
+    from ..runtime.context import MODEL_AXIS
+
+    n = axis_size(MODEL_AXIS)
+    perm = ring_perm(n)
+    vs = tab.shape[0]
+    nb = vs // block
+    off = lax.axis_index(MODEL_AXIS) * vs
+
+    def ring_step(carry, _):
+        # rotate FIRST: the bundle is loop-carried state only — the hop
+        # is compute-independent of this step's logit dots
+        h_c, tgt_c, stats = lax.ppermute(carry, MODEL_AXIS, perm)
+
+        def vblock(st, s):
+            logits, _ = _block_logits(h_c, tab, bs, s, block=block,
+                                      vocab=vocab, offset=off)
+            return _online_step(st, logits, off + s * block, tgt_c,
+                                block), None
+
+        stats, _ = lax.scan(vblock, stats, jnp.arange(nb))
+        return (h_c, tgt_c, stats), None
+
+    init = (h, tgt, _online_init(tgt.shape))
+    (_, _, (m, l, label, _, best_i)), _ = lax.scan(
+        ring_step, init, jnp.arange(n))
+    # n rotations = full circle: the stats are for OUR chunk again
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    return label - lse, best_i, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _tp_head_local(h, tgt, tab, bs, block, vocab):
+    logp, best, _ = _tp_head_fwd_local(h, tgt, tab, bs, block, vocab)
+    return logp, best
+
+
+def _tp_head_local_fwd(h, tgt, tab, bs, block, vocab):
+    logp, best, lse = _tp_head_fwd_local(h, tgt, tab, bs, block, vocab)
+    return (logp, best), (h, tgt, tab, bs, lse)
+
+
+def _tp_head_local_bwd(block, vocab, res, cotangents):
+    """Per-shard backward: rotate (hidden, targets, gy, lse, dhidden-
+    accumulator); each device recomputes its vocab shard's logits
+    blockwise for the visiting chunk (the flash-style recompute from the
+    saved lse), drains its dtable/dbias contribution locally as the
+    chunks pass, and the dhidden accumulator arrives home complete.
+    dtable/dbias leave per-shard; shard_map's transpose sums them over
+    ``data``. Every ppermute operand is loop-carried — both transposed
+    collectives hide under the recompute dots."""
+    from ..parallel.ring import axis_size, ring_perm
+    from ..runtime.context import MODEL_AXIS
+
+    g, _ = cotangents  # argmax is int: its cotangent is symbolic-zero
+    h, tgt, tab, bs, lse = res
+    n = axis_size(MODEL_AXIS)
+    perm = ring_perm(n)
+    vs = tab.shape[0]
+    nb = vs // block
+    off = lax.axis_index(MODEL_AXIS) * vs
+    gyf = g.astype(jnp.float32)
+
+    def ring_step(carry, _):
+        bundle, dtab, dbias = carry
+        h_c, tgt_c, gy_c, lse_c, dh_c = lax.ppermute(
+            bundle, MODEL_AXIS, perm)
+
+        def vblock(dh_c, s):
+            logits, tb = _block_logits(h_c, tab, bs, s, block=block,
+                                       vocab=vocab, offset=off)
+            p = jnp.exp(logits - lse_c[..., None])
+            v0 = off + s * block
+            in_blk = (tgt_c >= v0) & (tgt_c < v0 + block)
+            idx = jnp.clip(tgt_c - v0, 0, block - 1)
+            onehot = (jax.nn.one_hot(idx, block, dtype=jnp.float32)
+                      * in_blk[..., None].astype(jnp.float32))
+            dlogits = gy_c[..., None] * (onehot - p)
+            dh_c = dh_c + lax.dot_general(
+                dlogits, tb.astype(jnp.float32),
+                (((dlogits.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            batch_axes = tuple(range(dlogits.ndim - 1))
+            dtb = lax.dot_general(
+                dlogits, h_c.astype(jnp.float32),
+                ((batch_axes, batch_axes), ((), ())),
+                preferred_element_type=jnp.float32)
+            return dh_c, (dtb, jnp.sum(dlogits, axis=batch_axes))
+
+        dh_c, (dtbs, dbbs) = lax.scan(vblock, dh_c, jnp.arange(nb))
+        # this shard's dtable rows accumulate as the chunks pass; the
+        # per-block stacks reshape straight into the local layout
+        dtab = dtab + dtbs.reshape(vs, -1)
+        dbias = dbias + dbbs.reshape(vs)
+        return ((h_c, tgt_c, gy_c, lse_c, dh_c), dtab, dbias), None
+
+    dh0 = jnp.zeros(h.shape, jnp.float32)
+    dtab0 = jnp.zeros(tab.shape, jnp.float32)
+    dbias0 = jnp.zeros(bs.shape, jnp.float32)
+    ((_, _, _, _, dh), dtab, dbias), _ = lax.scan(
+        ring_step, ((h, tgt, gyf, lse, dh0), dtab0, dbias0),
+        jnp.arange(n))
+    return (dh.astype(h.dtype), None, dtab.astype(tab.dtype),
+            dbias.astype(bs.dtype))
+
+
+_tp_head_local.defvjp(_tp_head_local_fwd, _tp_head_local_bwd)
+
+
+def tp_lm_head_loss(hidden, table, targets, mesh, *, bias=None,
+                    block: int = 8192):
+    """``(token_logp, argmax)`` of a ``model``-sharded tied LM head whose
+    blockwise loss accumulates per-shard partial logits/logsumexp around
+    the ring — :func:`lm_head_loss` decomposed for ``--tp_overlap``.
+
+    Args match :func:`lm_head_loss` plus ``mesh`` (must carry a ``model``
+    axis; see ``parallel/collective_matmul.validate_tp_mesh``). ``hidden``
+    may arrive seq-sharded over ``model`` (the decomposed stack's output
+    layout) — the region specs consume it in place. Sequence length and
+    vocab are padded internally to ring granularity; outputs are sliced
+    back, and padded positions contribute exactly-zero gradients (the
+    pad/slice transposes zero their cotangents).
+
+    The custom_vjp sits on the per-shard function with ``shard_map``
+    outside (the ``parallel/collective_matmul.py`` structure note): the
+    hand-written ring backward is pinned per shard, and shard_map's
+    transpose supplies the cross-``data`` sums for dtable/dbias.
+    """
+    from ..parallel.collective_matmul import _batch_axis, validate_tp_mesh
+    from ..parallel.shard_map_compat import shard_map
+    from ..runtime.context import MODEL_AXIS
+    from jax.sharding import PartitionSpec as P
+
+    validate_tp_mesh(mesh)
+    n = mesh.shape[MODEL_AXIS]
+    ba = _batch_axis(mesh)
+    vocab, _ = table.shape
+    # local shard = a whole number of blocks; pad the global table to
+    # n * vs rows (absolute-id masking keeps padded rows at -inf)
+    block = min(block, -(-vocab // n))
+    vs = _num_blocks(-(-vocab // n), block) * block
+    pad_v = n * vs - vocab
+    if bias is None:
+        bias = jnp.zeros((vocab,), jnp.float32)
+    if pad_v:
+        table = jnp.pad(table, ((0, pad_v), (0, 0)))
+        bias = jnp.pad(bias, (0, pad_v))
+
+    hidden_p, t_real = _tp_pad_seq(hidden, n)
+    targets_p, _ = _tp_pad_seq(targets.astype(jnp.int32), n)
+
+    h_spec = P(ba, MODEL_AXIS, None)
+    t_spec = P(ba, MODEL_AXIS)
+
+    def local(h, tgt, tab, bs):
+        return _tp_head_local(h, tgt, tab, bs, block, vocab)
+
+    logp, best = shard_map(
+        local, mesh=mesh,
+        in_specs=(h_spec, t_spec, P(MODEL_AXIS, None), P(MODEL_AXIS)),
+        out_specs=(t_spec, t_spec), check_vma=False,
+    )(hidden_p, targets_p, table, bias)
+    # slice the seq padding back off
+    return logp[:, :t_real], best[:, :t_real]

@@ -1,0 +1,480 @@
+"""Decomposed tensor-parallel collective matmuls (``--tp_overlap``).
+
+Under plain ``mesh=...,model:N`` the Megatron-style layout
+(``parallel/sharding.py``: column-split fc1/qkv, row-split fc2/out —
+Shoeybi et al., *Megatron-LM*) leaves the collectives to GSPMD, whose
+default dataflow serialises every transformer block: "matmul → blocking
+psum/all-gather → matmul". The ICI sits idle during the dots and the dots
+wait on the wire. Wang et al. (*Overlap Communication with Dependent
+Computation via Decomposition*, ASPLOS 2023) show the fix: decompose each
+matmul+collective pair into ring steps whose single-hop ``ppermute``
+transfers hide under the partial dots — the same rotate-after-consume
+machinery ``parallel/ring.py`` uses for ring attention, applied to the TP
+projections themselves.
+
+Layout: between the collective matmuls, activations live **sequence-
+sharded over the ``model`` axis** (Megatron-LM sequence parallelism).
+Token-local ops (LayerNorm, residual adds, dropout, gelu) partition
+trivially on that layout; attention runs at the GSPMD level with heads
+sharded over ``model`` exactly as before. The two op shapes:
+
+- **all-gather-matmul** (column-split fc1/qkv): the input ``(B, T, E)`` is
+  seq-sharded; each device's weight shard holds a slice of the output
+  features. Instead of gathering T up front, each ring step consumes the
+  *held* activation chunk with a partial dot (writing that chunk's rows of
+  the output) while the next chunk rides a single-hop ``ppermute``. The
+  per-chunk dot is the same full-E contraction GSPMD's gathered matmul
+  performs, so this path is **bit-exact** vs the default.
+- **matmul-reduce-scatter** (row-split fc2/out): each device's partial
+  product would need one blocking psum under GSPMD. Here an accumulator
+  rotates around the ring: at step ``r`` device ``i`` adds its partial dot
+  for seq chunk ``(i - r - 1) mod n`` to the incoming accumulator, so
+  after ``n`` steps each device holds its own chunk *fully reduced* — the
+  psum never materialises as one blocking collective, and the output is
+  already in the seq-sharded layout the next column matmul consumes.
+  (Numerics: the cross-device sum is associated in ring order instead of
+  XLA's all-reduce order — last-ulp differences only.)
+
+Both ops carry a hand-written ``jax.custom_vjp`` (the r8/r9 pattern:
+``parallel/overlap.py``, ``parallel/compress.py``) so the backward
+pipelines the *transposed* collectives the same way instead of autodiffing
+into a serialised schedule: the column backward runs one ring that
+simultaneously reduce-scatters ``dx`` (rotating accumulator) and rotates
+the saved input chunks under the ``dw`` partial dots; the row backward
+rotates the output cotangent once, writing ``dh`` rows and accumulating
+``dw`` from the same held chunk. Weight cotangents are psum'd over
+``data`` *inside the region* — the DDP gradient reduce for the TP shards
+rides per-layer inside the backward, never as a trailing blocking wall.
+
+In every ring body the ``ppermute`` operands are loop-carried state, never
+a same-iteration dot product — the schedulability witness
+``parallel/overlap.hlo_overlap_evidence`` checks for, and what the XLA
+latency-hiding scheduler (``--xla_overlap_flags``) needs to run the hop
+under the dots. ``bench.py BENCH_MODE=tp`` records that evidence plus
+bit/last-ulp parity and the FLOPs-matched neutrality ratio.
+
+Scope (refused with intent): ``--scan_layers`` transformer stacks on
+``data×model`` meshes. ``seq``/``pipe``/``expert`` axes, MoE blocks and
+``--ddp_overlap``/``--fsdp`` need in-region handling this v1 does not
+implement. The divisibility contract (T, heads, mlp width by the model
+size) fails at trace time with named numbers, not an opaque shard_map
+shape error.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..runtime.context import DATA_AXIS, MODEL_AXIS
+from .ring import ring_perm, ring_source
+from .shard_map_compat import shard_map
+
+
+def validate_tp_mesh(mesh: Mesh | None) -> Mesh:
+    """Refuse meshes the decomposed-TP path cannot serve, with intent.
+
+    The ring regions rotate over ``model`` and shard the batch dim over
+    ``data`` only; a missing/size-1 ``model`` axis means there is nothing
+    to decompose, and a live ``seq``/``pipe``/``expert`` axis would be
+    silently unsharded by the region specs.
+    """
+    if mesh is None:
+        raise ValueError(
+            "--tp_overlap needs the device mesh threaded into the model "
+            "(models/registry.py does this; pass mesh= when building "
+            "directly)"
+        )
+    if mesh.shape.get(MODEL_AXIS, 1) <= 1:
+        raise ValueError(
+            "--tp_overlap decomposes the tensor-parallel collectives of a "
+            f"'{MODEL_AXIS}' mesh axis, but the mesh is "
+            f"{dict(mesh.shape)} (data-only / model:1) — there is no TP "
+            "matmul to overlap; add model:N to --mesh or drop --tp_overlap"
+        )
+    extra = {name: size for name, size in mesh.shape.items()
+             if name not in (DATA_AXIS, MODEL_AXIS) and size > 1}
+    if extra:
+        raise ValueError(
+            f"--tp_overlap supports data+model meshes only; mesh also has "
+            f"{extra} — drop the extra axes or drop --tp_overlap"
+        )
+    return mesh
+
+
+def _batch_axis(mesh: Mesh) -> str | None:
+    return DATA_AXIS if mesh.shape.get(DATA_AXIS, 1) > 1 else None
+
+
+def _check_divisible(what: str, value: int, n: int) -> None:
+    if value % n:
+        raise ValueError(
+            f"--tp_overlap needs {what} ({value}) divisible by the model-"
+            f"axis size ({n}) so the ring chunks are uniform; adjust the "
+            "mesh or the model geometry"
+        )
+
+
+# -- local ring kernels (run INSIDE shard_map over the model axis) ---------
+#
+# Structure note: the ``jax.custom_vjp`` sits on the LOCAL (per-shard)
+# function and ``shard_map`` wraps it from outside — not the other way
+# round. Autodiff then differentiates *through* shard_map (whose jvp/
+# transpose rules are solid, and whose transpose SUMS each cotangent over
+# the mesh axes its input spec does not mention — the cross-replica
+# weight-grad reduce comes free, per-layer, inside the backward), while
+# the custom rules still pin the per-shard backward to hand-written ring
+# schedules. The inverted nesting (custom_vjp around shard_map) leaks
+# tracers on this jaxlib when the region body carries an inner lax.scan
+# and the op runs inside flax's lifted nn.scan under jax.grad — the
+# shard_map-internal operand reshape is captured across the custom_vjp
+# boundary (observed UnexpectedTracerError; see tests/test_collective_
+# matmul.py's scanned-grad case, which pins the working composition).
+#
+# Chunk-index conventions, shared with parallel/ring.py:
+# * rotate-after-consume (all-gather side): the chunk held at step r
+#   originated at shard ``ring_source(my, r, n) = (my - r) % n``; the
+#   ppermute input is the loop-carried chunk, never this step's dot.
+# * rotate-at-start (reduce-scatter side): the accumulator arriving at
+#   device i at step r belongs to seq chunk ``(i - r - 1) % n``; after the
+#   final step (r = n-1) that index is i — each device ends holding its
+#   own chunk fully reduced. The ppermute input is the loop-carried
+#   accumulator; the partial dot feeding the add is independent of it.
+
+def _ring_size() -> int:
+    from .ring import axis_size
+
+    return axis_size(MODEL_AXIS)
+
+
+def _dot2(a: jax.Array, w: jax.Array) -> jax.Array:
+    """``(..., K) @ (K, F) -> (..., F)`` contracting the last dim."""
+    return lax.dot_general(a, w, (((a.ndim - 1,), (0,)), ((), ())))
+
+
+def _ag_matmul_local(chunk: jax.Array, wcat: jax.Array) -> jax.Array:
+    """All-gather-matmul: seq chunk ``(B, t, E)`` x ``(E, F)`` -> full-seq
+    ``(B, n*t, F)``, one output slice per ring step."""
+    n = _ring_size()
+    my = lax.axis_index(MODEL_AXIS)
+    perm = ring_perm(n)
+    b, t, _ = chunk.shape
+    out = jnp.zeros((b, n * t, wcat.shape[-1]),
+                    jnp.result_type(chunk.dtype, wcat.dtype))
+
+    def body(carry, r):
+        out, chunk = carry
+        src = ring_source(my, r, n)
+        # the dot consumes only the held chunk; the rotation below has no
+        # data dependence on it — the hop hides under the next dot
+        part = _dot2(chunk, wcat)
+        out = lax.dynamic_update_slice_in_dim(out, part, src * t, axis=1)
+        chunk = lax.ppermute(chunk, MODEL_AXIS, perm)
+        return (out, chunk), None
+
+    (out, _), _ = lax.scan(body, (out, chunk), jnp.arange(n))
+    return out
+
+
+def _mm_rs_local(h: jax.Array, w: jax.Array) -> jax.Array:
+    """Matmul-reduce-scatter: full-seq ``(B, n*t, K)`` x ``(K, E)`` ->
+    fully-reduced own seq chunk ``(B, t, E)``, partials reduced around the
+    ring (the psum never exists as one blocking collective)."""
+    n = _ring_size()
+    my = lax.axis_index(MODEL_AXIS)
+    perm = ring_perm(n)
+    t = h.shape[1] // n
+    acc = jnp.zeros((h.shape[0], t, w.shape[-1]),
+                    jnp.result_type(h.dtype, w.dtype))
+
+    def body(acc, r):
+        # rotate FIRST: the ppermute consumes only the loop-carried
+        # accumulator; this step's partial dot is independent of it
+        acc = lax.ppermute(acc, MODEL_AXIS, perm)
+        c = (my - r - 1) % n
+        h_c = lax.dynamic_slice_in_dim(h, c * t, t, axis=1)
+        return acc + _dot2(h_c, w), None
+
+    acc, _ = lax.scan(body, acc, jnp.arange(n))
+    return acc
+
+
+# -- column op: y_i = AG(x) @ w_i + b_i (fc1 / fused qkv) ------------------
+
+def _col_math(x_c, kernels, biases):
+    sizes = [math.prod(k.shape[1:]) for k in kernels]  # local widths
+    wcat = jnp.concatenate(
+        [k.reshape(k.shape[0], -1) for k in kernels], axis=1)
+    out = _ag_matmul_local(x_c, wcat)
+    outs, off = [], 0
+    for k, b, sz in zip(kernels, biases, sizes):
+        y = out[..., off:off + sz] + b.reshape(-1)
+        outs.append(y.reshape(*y.shape[:-1], *k.shape[1:]))
+        off += sz
+    return tuple(outs)
+
+
+@jax.custom_vjp
+def _col_local(x_c, kernels, biases):
+    return _col_math(x_c, kernels, biases)
+
+
+def _col_local_fwd(x_c, kernels, biases):
+    return _col_math(x_c, kernels, biases), (x_c, kernels)
+
+
+def _col_local_bwd(res, gys):
+    """One ring serving both transposed collectives: the ``dx``
+    reduce-scatter accumulator rotates at start of each step while the
+    saved input chunk rotates after its ``dw`` partial dot — every
+    ppermute operand is loop-carried, so both hops can run under the
+    step's dots. Weight/bias cotangents leave the region per-shard;
+    shard_map's transpose sums them over the ``data`` axis (their specs
+    do not mention it) — the cross-replica grad reduce, per layer,
+    inside the backward."""
+    x_c, kernels = res
+    n = _ring_size()
+    sizes = [math.prod(k.shape[1:]) for k in kernels]
+    wcat = jnp.concatenate(
+        [k.reshape(k.shape[0], -1) for k in kernels], axis=1)
+    gcat = jnp.concatenate(
+        [g.reshape(*g.shape[:2], -1) for g in gys], axis=-1)
+    my = lax.axis_index(MODEL_AXIS)
+    perm = ring_perm(n)
+    t = x_c.shape[1]
+    dx = jnp.zeros(x_c.shape, jnp.result_type(gcat.dtype, wcat.dtype))
+    dw = jnp.zeros((wcat.shape[0], wcat.shape[1]), jnp.float32)
+
+    def body(carry, r):
+        dx, chunk, dw = carry
+        # dx: reduce-scatter of gcat @ wcat^T — rotate-at-start
+        dx = lax.ppermute(dx, MODEL_AXIS, perm)
+        c = (my - r - 1) % n
+        g_c = lax.dynamic_slice_in_dim(gcat, c * t, t, axis=1)
+        dx = dx + lax.dot_general(
+            g_c, wcat, (((g_c.ndim - 1,), (1,)), ((), ())))
+        # dw: the saved input chunk rotates (rotate-after-consume) under
+        # its partial dot with the matching cotangent slice
+        src = ring_source(my, r, n)
+        g_src = lax.dynamic_slice_in_dim(gcat, src * t, t, axis=1)
+        dw = dw + lax.dot_general(
+            chunk, g_src, (((0, 1), (0, 1)), ((), ())),
+            preferred_element_type=jnp.float32)
+        chunk = lax.ppermute(chunk, MODEL_AXIS, perm)
+        return (dx, chunk, dw), None
+
+    (dx, _, dw), _ = lax.scan(body, (dx, x_c, dw), jnp.arange(n))
+    dks, dbs, off = [], [], 0
+    for k, g, sz in zip(kernels, gys, sizes):
+        dks.append(dw[:, off:off + sz].reshape(k.shape).astype(k.dtype))
+        dbs.append(jnp.sum(g.astype(jnp.float32), axis=(0, 1))
+                   .astype(g.dtype))
+        off += sz
+    return dx.astype(x_c.dtype), tuple(dks), tuple(dbs)
+
+
+_col_local.defvjp(_col_local_fwd, _col_local_bwd)
+
+
+def tp_column_dense(x: jax.Array, kernels: Sequence[jax.Array],
+                    biases: Sequence[jax.Array], mesh: Mesh,
+                    ) -> list[jax.Array]:
+    """Ring-overlapped column-split dense layer(s).
+
+    ``x``: ``(B, T, E)``, seq-sharded over ``model`` (dim 1). Each
+    ``kernels[i]``: ``(E, F, *rest)`` with the first feature dim ``F``
+    sharded over ``model``; ``biases[i]``: ``(F, *rest)``. Returns one
+    ``(B, T, F, *rest)`` output per kernel, feature-sharded over ``model``.
+
+    Passing several kernels fuses them into ONE ring: the activation
+    rotates once and every projection's partial dot consumes the same held
+    chunk (the fused-qkv path — a third of the separate-rings wire).
+    """
+    n = mesh.shape[MODEL_AXIS]
+    ba = _batch_axis(mesh)
+    _check_divisible("sequence length", x.shape[1], n)
+    for k in kernels:
+        _check_divisible("feature width", k.shape[1], n)
+    x_spec = P(ba, MODEL_AXIS, None)
+    k_specs = tuple(P(None, MODEL_AXIS, *([None] * (k.ndim - 2)))
+                    for k in kernels)
+    b_specs = tuple(P(MODEL_AXIS, *([None] * (k.ndim - 2)))
+                    for k in kernels)
+    y_specs = tuple(P(ba, None, MODEL_AXIS, *([None] * (k.ndim - 2)))
+                    for k in kernels)
+    out = shard_map(_col_local, mesh=mesh,
+                    in_specs=(x_spec, k_specs, b_specs),
+                    out_specs=y_specs, check_vma=False)(
+        x, tuple(kernels), tuple(biases))
+    return list(out)
+
+
+# -- row op: y = RS(h @ w) + b (fc2 / out projection) ----------------------
+
+def _row_math(h_l, w_l, b):
+    h2 = h_l.reshape(*h_l.shape[:2], -1)
+    w2 = w_l.reshape(-1, w_l.shape[-1])
+    # each device adds the replicated bias to its own reduced chunk
+    # exactly once — the same "add after psum" the default path does
+    return _mm_rs_local(h2, w2) + b
+
+
+@jax.custom_vjp
+def _row_local(h_l, w_l, b):
+    return _row_math(h_l, w_l, b)
+
+
+def _row_local_fwd(h_l, w_l, b):
+    return _row_math(h_l, w_l, b), (h_l, w_l)
+
+
+def _row_local_bwd(res, g):
+    """One rotation of the seq-sharded output cotangent serves both
+    transposed collectives: each step writes the held chunk's ``dh`` rows
+    (all-gather-matmul against ``w^T``) and accumulates its ``dw``
+    partial from the same chunk. ``db`` is the local sum only —
+    shard_map's transpose sums it over BOTH mesh axes (its spec is
+    ``P()``), and ``dw`` over ``data``."""
+    h_l, w_l = res
+    n = _ring_size()
+    h2 = h_l.reshape(*h_l.shape[:2], -1)
+    w2 = w_l.reshape(-1, w_l.shape[-1])
+    my = lax.axis_index(MODEL_AXIS)
+    perm = ring_perm(n)
+    t = g.shape[1]
+    dh = jnp.zeros(h2.shape, jnp.result_type(g.dtype, w2.dtype))
+    dw = jnp.zeros(w2.shape, jnp.float32)
+
+    def body(carry, r):
+        dh, chunk, dw = carry
+        src = ring_source(my, r, n)
+        # dh rows for the held chunk: all-gather-matmul vs w^T
+        part = lax.dot_general(
+            chunk, w2, (((chunk.ndim - 1,), (1,)), ((), ())))
+        dh = lax.dynamic_update_slice_in_dim(dh, part, src * t, axis=1)
+        # dw partial from the SAME held chunk — one rotation, two
+        # transposed collectives
+        h_src = lax.dynamic_slice_in_dim(h2, src * t, t, axis=1)
+        dw = dw + lax.dot_general(
+            h_src, chunk, (((0, 1), (0, 1)), ((), ())),
+            preferred_element_type=jnp.float32)
+        chunk = lax.ppermute(chunk, MODEL_AXIS, perm)
+        return (dh, chunk, dw), None
+
+    (dh, _, dw), _ = lax.scan(body, (dh, g, dw), jnp.arange(n))
+    db = jnp.sum(g.astype(jnp.float32), axis=(0, 1))
+    return (dh.reshape(h_l.shape).astype(h_l.dtype),
+            dw.reshape(w_l.shape).astype(w_l.dtype),
+            db.astype(g.dtype))
+
+
+_row_local.defvjp(_row_local_fwd, _row_local_bwd)
+
+
+def tp_row_dense(h: jax.Array, kernel: jax.Array, bias: jax.Array,
+                 mesh: Mesh) -> jax.Array:
+    """Ring-overlapped row-split dense layer.
+
+    ``h``: ``(B, T, K, *rest)`` with the first contraction dim ``K``
+    sharded over ``model``; ``kernel``: ``(K, *rest, E)`` row-sharded on
+    ``K``; ``bias``: ``(E,)`` replicated. Returns ``(B, T, E)``
+    seq-sharded over ``model`` — the partial products are reduced around
+    the ring straight into the layout the next column matmul consumes.
+    """
+    n = mesh.shape[MODEL_AXIS]
+    ba = _batch_axis(mesh)
+    _check_divisible("sequence length", h.shape[1], n)
+    _check_divisible("contraction width", h.shape[2], n)
+    if h.shape[2] != kernel.shape[0]:
+        raise ValueError(
+            f"tp_row_dense: input contraction dims {h.shape[2:]} do not "
+            f"match kernel {kernel.shape[:-1]}"
+        )
+    h_spec = P(ba, None, MODEL_AXIS, *([None] * (h.ndim - 3)))
+    k_spec = P(MODEL_AXIS, *([None] * (kernel.ndim - 1)))
+    y_spec = P(ba, MODEL_AXIS, None)
+    return shard_map(_row_local, mesh=mesh,
+                     in_specs=(h_spec, k_spec, P()),
+                     out_specs=y_spec, check_vma=False)(h, kernel, bias)
+
+
+# -- wire accounting -------------------------------------------------------
+
+#: ring payload streams per block per step: forward = fused-qkv AG + fc1 AG
+#: + out RS + fc2 RS (4); backward = column dx-accumulator + column input
+#: rotation (x2 for qkv and fc1) + one cotangent rotation each for out and
+#: fc2 (the fused dh/dw rings) = 6
+STACK_RINGS_FWD = 4
+STACK_RINGS_BWD = 6
+
+
+def tp_wire_bytes_per_step(*, batch: int, seq: int, embed: int,
+                           num_layers: int, n: int, vocab: int | None = None,
+                           itemsize: int = 4) -> dict[str, int]:
+    """Estimated model-axis TP bytes on the wire per optimizer step.
+
+    One ring op moves ``(n-1)/n`` of its full activation per model group:
+    every participant sends ``n-1`` chunks of ``batch_local * t * embed``,
+    which totals ``(n-1) * batch * seq * embed * itemsize`` across the job
+    (independent of the data-axis size — the rings run once per data
+    group on 1/data of the batch). The stack runs
+    :data:`STACK_RINGS_FWD` + :data:`STACK_RINGS_BWD` such payload streams
+    per layer; the LM head (``vocab`` set) rotates its
+    (hidden, targets, online-stats) bundle forward and the
+    (hidden, targets, cotangent, lse, dhidden-accumulator) bundle
+    backward. Mirrors ``parallel/compress.wire_bytes_per_step``'s
+    total-bytes convention: the fp32-vs-decomposed *ratios* are exact,
+    absolute numbers are the upper bound with nothing kept local.
+
+    Weight-grad psums over ``data`` are DDP bytes, not TP bytes, and are
+    deliberately not counted here (``describe()`` reports them via the r9
+    ``grad_wire_mb`` fields when compression is on).
+    """
+    per_ring = (n - 1) * batch * seq * embed * itemsize
+    stack = num_layers * (STACK_RINGS_FWD + STACK_RINGS_BWD) * per_ring
+    head = 0
+    if vocab is not None:
+        tokens = (n - 1) * batch * seq
+        # fwd bundle: hidden (E*itemsize) + targets (i32) + m/l/label/
+        # best_v (f32) + best_i (i32) per token
+        head += tokens * (embed * itemsize + 4 + 5 * 4)
+        # bwd bundle: hidden + dhidden accumulator (f32) + targets + gy +
+        # lse per token
+        head += tokens * (embed * itemsize + embed * 4 + 3 * 4)
+    return {"stack": int(stack), "head": int(head)}
+
+
+# -- HLO schedule evidence -------------------------------------------------
+
+def hlo_tp_evidence(hlo_text: str) -> dict[str, Any]:
+    """Ring-schedule witness for a compiled ``--tp_overlap`` program.
+
+    Reuses ``parallel/overlap.hlo_overlap_evidence``'s loop-body operand
+    walk with the collective set narrowed to ``collective-permute`` (the
+    only collective the ring kernels issue on the hot path): a dot-
+    carrying loop body whose ppermute operands reach only loop-carried
+    state is a ring step the latency-hiding scheduler may run under the
+    dots. Headline counts: ``ring_bodies`` (dot-carrying bodies with any
+    ppermute) and ``independent_ring_bodies`` (all of whose ppermutes are
+    compute-independent). Callers compare a forward-only lowering against
+    the full train step to attribute bodies to fwd vs bwd (instruction
+    text alone cannot).
+    """
+    from .overlap import hlo_overlap_evidence
+
+    ev = hlo_overlap_evidence(hlo_text, collectives=("collective-permute",))
+    bodies = ev["bodies"]
+    independent = [r for r in bodies
+                   if r["compute_independent_collectives"] > 0
+                   and r["compute_dependent_collectives"] == 0]
+    return {
+        "bodies": bodies,
+        "ring_bodies": len(bodies),
+        "independent_ring_bodies": len(independent),
+    }

@@ -44,6 +44,28 @@ def _axis_size(axis_name) -> int:
     frame = _core.axis_frame(axis_name)
     return int(frame if isinstance(frame, int) else frame.size)
 
+
+#: public spelling — parallel/collective_matmul.py and ops/lm_head.py share
+#: the ring machinery below; the underscore name stays for old importers
+axis_size = _axis_size
+
+
+def ring_perm(n: int) -> list[tuple[int, int]]:
+    """The single-hop neighbour permutation ``i -> i+1 (mod n)`` every ring
+    in this codebase rotates by (attention kv chunks here; activation
+    chunks and reduce accumulators in ``parallel/collective_matmul.py``;
+    the hidden/state bundle in ``ops/lm_head.py``). One hop per step rides
+    one ICI link — bandwidth-optimal on the torus."""
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def ring_source(my, r, n: int):
+    """Origin shard of the chunk device ``my`` holds after ``r`` rotations
+    of :func:`ring_perm` with the *rotate-after-consume* schedule (consume
+    the held chunk, then ppermute it): at step ``r`` the chunk in hand
+    started at ``(my - r) mod n``. Works on ints and traced arrays."""
+    return (my - r) % n
+
 from ..ops.attention import (
     online_softmax_finish,
     online_softmax_init,
@@ -76,12 +98,12 @@ def ring_attention_local(
     b, s_loc, h, d = q.shape
     scale = d ** -0.5
     qf = (q.astype(jnp.float32) * scale).transpose(0, 2, 1, 3)  # (B,H,S,D)
-    perm = [(i, (i + 1) % n) for i in range(n)]
+    perm = ring_perm(n)
     has_mask = kv_mask is not None
 
     def body(carry, r):
         state, kc, vc, mc = carry if has_mask else (*carry, None)
-        src = (my - r) % n  # origin shard of the chunk we currently hold
+        src = ring_source(my, r, n)  # origin shard of the held chunk
         state = online_softmax_update(
             state,
             qf,

@@ -203,7 +203,7 @@ def fsdp_reshard(tree: Any, mesh: Mesh,
 
 
 def describe(mesh: Mesh, config: Any = None,
-             params: Any = None) -> dict[str, Any]:
+             params: Any = None, model: Any = None) -> dict[str, Any]:
     """Human-readable sharding summary for the startup log.
 
     With ``config`` (a ``TrainingConfig``) the summary also names the
@@ -213,6 +213,13 @@ def describe(mesh: Mesh, config: Any = None,
     are supplied as well, a histogram of which dim each leaf's FSDP split
     landed on (``{"dim0": 12, "unsplit": 3}``-style), so a run's log
     records the layer-granular-vs-within-layer layout decision.
+
+    On meshes with a live ``model`` axis the summary names the TP
+    execution mode (``"ring-decomposed"`` under ``--tp_overlap``,
+    ``parallel/collective_matmul.py``, vs ``"gspmd-default"``), and with
+    ``model`` (the Flax module — the engine passes ``task.model``) it
+    reports the per-step model-axis wire bytes, stack and LM head split
+    out — the r9 ``grad_wire_mb`` convention applied to the TP axis.
     """
     sizes = dict(mesh.shape)
     out: dict[str, Any] = {
@@ -223,6 +230,35 @@ def describe(mesh: Mesh, config: Any = None,
         "expert_parallel": sizes.get(EXPERT_AXIS, 1),
     }
     if config is not None:
+        tp_on = bool(getattr(config, "tp_overlap", False))
+        if tp_on or sizes.get(MODEL_AXIS, 1) > 1:
+            out["tp_mode"] = "ring-decomposed" if tp_on else "gspmd-default"
+        if tp_on and model is not None:
+            dims = {k: getattr(model, k, None)
+                    for k in ("max_len", "num_heads", "head_dim",
+                              "num_layers")}
+            if all(v is not None for v in dims.values()):
+                from .collective_matmul import tp_wire_bytes_per_step
+
+                vocab = (getattr(model, "vocab_size", None)
+                         if getattr(model, "fused_head", False) else None)
+                # batch from the mesh in hand, not config.train_batch_size
+                # (whose data size comes from the config.mesh string and
+                # can disagree with the mesh argument)
+                wires = tp_wire_bytes_per_step(
+                    batch=(config.per_device_train_batch_size
+                           * sizes.get(DATA_AXIS, 1)),
+                    seq=dims["max_len"],
+                    embed=dims["num_heads"] * dims["head_dim"],
+                    num_layers=dims["num_layers"],
+                    n=sizes.get(MODEL_AXIS, 1),
+                    vocab=vocab,
+                    itemsize=2 if getattr(config, "bf16", False) else 4,
+                )
+                out["tp_wire_mb_stack"] = round(wires["stack"] / 1e6, 3)
+                out["tp_wire_mb_head"] = round(wires["head"] / 1e6, 3)
+                out["tp_wire_mb_per_step"] = round(
+                    (wires["stack"] + wires["head"]) / 1e6, 3)
         if getattr(config, "fsdp", False):
             out["fsdp_mode"] = ("decomposed-prefetch"
                                 if getattr(config, "fsdp_overlap", False)

@@ -523,10 +523,13 @@ class Trainer:
                 "accum_steps": cfg.gradient_accumulation_steps,
                 "total_optimizer_steps": self.total_steps,
                 "resumed_at_step": start_step,
-                # mesh + active FSDP execution mode (gspmd-default vs
-                # decomposed-prefetch) + per-leaf split-dim histogram: the
-                # run log records WHICH layout/schedule produced its numbers
-                **describe(self.ctx.mesh, cfg, state.params),
+                # mesh + active FSDP/TP execution modes (gspmd-default vs
+                # decomposed) + per-leaf split-dim histogram + TP wire
+                # bytes: the run log records WHICH layout/schedule
+                # produced its numbers (model= supplies the geometry the
+                # TP wire accounting needs)
+                **describe(self.ctx.mesh, cfg, state.params,
+                           model=self.task.model),
             },
         )
 

@@ -204,6 +204,80 @@ def test_comms_mode_contract():
         assert k in row
 
 
+@pytest.mark.slow
+def test_tp_mode_contract():
+    """BENCH_MODE=tp: one JSON line carrying the decomposed-TP legs —
+    default-vs-ring parity, the column-op bit probe, fwd/bwd HLO ring
+    evidence, wire split and the memory fields (slow: a subprocess
+    compiling three small train steps; the committed record in
+    bench_records/tp_cpu_r10.jsonl is the tier-1-visible evidence)."""
+    code, lines, out = run_bench({
+        "BENCH_MODE": "tp", "BENCH_CPU_DEVICES": "4",
+        "BENCH_DEPTH": "2", "BENCH_SEQ": "32", "BENCH_VOCAB": "512",
+        "BENCH_BATCH": "1", "BENCH_WARMUP": "1", "BENCH_STEPS": "2",
+    })
+    assert code == 0, out[-2000:]
+    assert len(lines) == 1, out[-2000:]
+    row = lines[0]
+    assert REQUIRED <= set(row)
+    assert row["metric"] == "tp_overlap_step_ratio_2L"
+    assert row["degenerate"] is False
+    assert row["value"] > 0
+    # the two execution paths trained the same model: tight parity
+    assert abs(row["loss_default"] - row["loss_tp"]) < 1e-5
+    assert row["parity_max_abs_diff"] < 1e-6
+    assert row["col_bit_exact"] is True
+    # ring evidence: compute-independent ppermute chains in BOTH passes
+    assert row["hlo_fwd_ring_independent"] is True
+    assert row["hlo_bwd_ring_independent"] is True
+    assert row["hlo_fwd_independent_ring_bodies"] > 0
+    assert row["hlo_bwd_independent_ring_bodies"] > 0
+    # wire split present and consistent
+    assert row["tp_wire_mb_per_step"] == pytest.approx(
+        row["tp_wire_mb_stack"] + row["tp_wire_mb_head"], abs=2e-3)
+    # memory leg computed (its True/False verdict needs a real vocab —
+    # the committed-record test asserts it; tiny-vocab temps are noise)
+    assert "live_range_ok" in row
+
+
+def test_tp_mode_single_chip_degenerate():
+    """One device = no model axis: the tp mode must emit a degenerate
+    zero-value line (r8 convention), never a fake pass."""
+    code, lines, out = run_bench({
+        "BENCH_MODE": "tp", "BENCH_CPU_DEVICES": "1",
+    })
+    assert code == 0, out[-2000:]
+    row = lines[-1]
+    assert row["degenerate"] is True
+    assert row["value"] == 0.0 and row["vs_baseline"] == 0.0
+
+
+def test_tp_record_committed_and_affirmative():
+    """The committed round-10 CPU record must exist and actually show the
+    evidence the round claims: column bit-exactness, default-vs-ring
+    parity at fp tolerance, independent ring bodies in both fwd and bwd,
+    the never-materialised-logits live range, and neutrality-or-better on
+    the FLOPs-matched step-time pair."""
+    import json
+    from pathlib import Path
+
+    path = Path(__file__).resolve().parent.parent / "bench_records" / \
+        "tp_cpu_r10.jsonl"
+    assert path.is_file(), "run BENCH_MODE=tp to record the legs"
+    records = [json.loads(l) for l in path.read_text().splitlines() if l]
+    assert records
+    last = records[-1]
+    assert last["metric"].startswith("tp_overlap_step_ratio")
+    assert last["degenerate"] is False
+    assert last["col_bit_exact"] is True
+    assert last["parity_max_abs_diff"] < 1e-6
+    assert last["hlo_fwd_ring_independent"] is True
+    assert last["hlo_bwd_ring_independent"] is True
+    assert last["live_range_ok"] is True
+    # neutrality-or-better on the recorded pair (0.9 band -> vs_baseline)
+    assert last["vs_baseline"] >= 1.0
+
+
 def test_comms_record_committed_and_affirmative():
     """The committed round-9 CPU record must exist and actually show the
     evidence the round claims: >= depth independent in-scan reduces, int8

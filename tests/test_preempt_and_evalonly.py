@@ -79,6 +79,9 @@ class TestSigtermGracefulStop:
 
 
 class TestKitchenSink:
+    @pytest.mark.slow  # two full CLI subprocesses (~41s): moved to the
+    #                    slow set in r10 to keep the grown suite inside
+    #                    the 870s budget (the r8/r9 convention)
     def test_all_round4_flags_compose(self, tmp_path):
         """--fsdp + --remat + --fused_head + --optimizer lamb + eval +
         resume, on a data x model mesh, through the real CLI: the flags
