@@ -54,7 +54,7 @@ PEAK_FLOPS = {
     "TPU v2": 45e12,
 }
 
-MODE = os.environ.get("BENCH_MODE", "train")  # train | e2e | scaling | flash | compile | overlap | comms | tp
+MODE = os.environ.get("BENCH_MODE", "train")  # train | e2e | scaling | flash | compile | overlap | comms | tp | overlap3d
 MODEL = os.environ.get("BENCH_MODEL", "resnet50")
 WARMUP_STEPS = int(os.environ.get("BENCH_WARMUP", "5"))
 TIMED_STEPS = int(os.environ.get("BENCH_STEPS", "30"))
@@ -75,7 +75,8 @@ def _emit(payload: dict) -> None:
 #: changed via BENCH_DEPTH) must never be cited as the best-known
 #: HEADLINE config during an outage
 ABLATION_KEYS = ("remat", "fused_head", "dense_head", "flash_disabled",
-                 "num_layers", "scan_layers", "ddp_overlap", "tp_overlap")
+                 "num_layers", "scan_layers", "ddp_overlap", "tp_overlap",
+                 "fsdp_overlap")
 
 
 def _last_recorded(metric: str) -> dict | None:
@@ -362,6 +363,20 @@ def run_bench(model: str, metric: str, unit: str, baseline: float,
         if hasattr(task.model, "fused_head"):
             kwargs["fused_head"] = True  # the ring vocab head IS the head
         task.model = task.model.clone(**kwargs)
+    fsdp_overlap = os.environ.get("BENCH_FSDP_OVERLAP", "") == "1"
+    if fsdp_overlap:  # decomposed-FSDP / composed fsdp×tp train leg (r11)
+        if not scan:
+            raise ValueError("BENCH_FSDP_OVERLAP=1 needs BENCH_SCAN=1 "
+                             "(the stacked layout is the schedule's unit)")
+        if ddp_overlap:
+            raise ValueError("BENCH_FSDP_OVERLAP=1 cannot compose with "
+                             "BENCH_DDP_OVERLAP=1 (params cannot be both "
+                             "sharded and replicated)")
+        if not hasattr(task.model, "fsdp_overlap"):
+            raise ValueError(
+                f"BENCH_FSDP_OVERLAP: model {model!r} has no decomposed-"
+                "FSDP execution path")
+        task.model = task.model.clone(fsdp_overlap=True, mesh=mesh)
 
     global_batch = per_device * data_size
     idx = np.arange(global_batch) % len(dataset)
@@ -381,6 +396,15 @@ def run_bench(model: str, metric: str, unit: str, baseline: float,
         rng=jax.random.clone(seed_key),
     )
     state = shard_tree(state, mesh)  # unbox + place per logical annotations
+    if fsdp_overlap:
+        from pytorch_ddp_template_tpu.parallel.sharding import fsdp_reshard
+
+        # the gather schedule consumes the fsdp layout the trainer would
+        # place: layer-dim (prefer_dim=0) data split over the stack
+        state = state.replace(
+            params=fsdp_reshard(state.params, mesh, prefer_dim=0),
+            opt_state=fsdp_reshard(state.opt_state, mesh, prefer_dim=0),
+        )
     # AOT-compile once and drive the loops with the same executable — a
     # plain call would trace+compile the identical program a second time
     train_step = make_train_step(task, tx, schedule, accum_steps=1).lower(
@@ -433,6 +457,8 @@ def run_bench(model: str, metric: str, unit: str, baseline: float,
     if tp_overlap:
         out["tp_overlap"] = True
         out["mesh"] = mesh_spec
+    if fsdp_overlap:
+        out["fsdp_overlap"] = True
     if os.environ.get("FLASH_DISABLE", "") == "1":
         out["flash_disabled"] = True
     try:  # compiled-executable memory breakdown (peak-memory evidence for
@@ -1394,6 +1420,234 @@ def run_tp() -> dict:
     }
 
 
+def run_overlap3d() -> dict:
+    """Composed-schedule proof (round 11, parallel/schedule.py): the
+    unified decomposed scan running fsdp×tp — data-axis weight gathers
+    pipelined one layer ahead WHILE the block's ring collective matmuls
+    rotate over ``model`` — vs the FLOPs-matched GSPMD default on the
+    same ``data × model`` mesh.
+
+    Legs, sized for what THIS host can prove (the real multi-chip pair
+    rides in tools/tpu_followup_r11.sh):
+
+    - **parity**: one optimizer step from identical init, composed vs
+      default (loss delta + max param divergence; ring reassociation +
+      gather psums = last-f32-ulp), plus an eval-mode loss/grad probe of
+      the ddp×tp composition against the replicated GSPMD default.
+    - **HLO schedule evidence**: ``hlo_composed_evidence`` on the
+      composed train step — at least one dot-carrying scanned body whose
+      gather-family collectives (data axis) are compute-independent AND
+      that reaches compute-independent ring ppermutes (model axis): both
+      axes' collectives schedulable in ONE scanned body.
+    - **step-time neutrality**: alternating min-of-reps pair. The
+      default runs ``--remat`` so both paths recompute blocks in
+      backward (the composed path's recompute-from-boundary is implicit
+      block remat — the r9 FLOPs-matching convention); the schedule is
+      the only difference, 0.9 band carries the headline.
+    - **wire accounting**: the model-axis TP bytes for the bench
+      geometry (the fsdp gathers move layout-dependent bytes GSPMD also
+      moves — not double-counted).
+
+    Degenerate contract: fewer than 4 devices (no data×model mesh worth
+    composing) emits ``degenerate: true`` with value 0 (r8 convention).
+
+    Knobs: BENCH_DEPTH (default 4), BENCH_SEQ (64), BENCH_VOCAB (4096),
+    BENCH_TP (model-axis size, default 2), BENCH_BATCH (per data-shard),
+    BENCH_STEPS/BENCH_WARMUP.
+    """
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pytorch_ddp_template_tpu.config import TrainingConfig
+    from pytorch_ddp_template_tpu.models.gpt import CausalLmTask, GptDecoder
+    from pytorch_ddp_template_tpu.parallel.collective_matmul import (
+        tp_wire_bytes_per_step,
+    )
+    from pytorch_ddp_template_tpu.parallel.schedule import (
+        hlo_composed_evidence,
+    )
+    from pytorch_ddp_template_tpu.parallel.sharding import (
+        fsdp_reshard, shard_tree,
+    )
+    from pytorch_ddp_template_tpu.runtime import make_mesh
+    from pytorch_ddp_template_tpu.train.engine import (
+        TrainState,
+        make_optimizer,
+        make_train_step,
+    )
+
+    depth = int(os.environ.get("BENCH_DEPTH", "0")) or 4
+    seq = int(os.environ.get("BENCH_SEQ", "64"))
+    vocab = int(os.environ.get("BENCH_VOCAB", "4096"))
+    tp_size = int(os.environ.get("BENCH_TP", "2"))
+    devices = jax.devices()
+    metric = f"overlap3d_step_ratio_{depth}L"
+    unit = "x_default_step_time"
+    if (len(devices) < 4 or len(devices) % tp_size
+            or len(devices) // tp_size < 2):
+        return {  # no data×model mesh worth composing (r8 convention)
+            "metric": metric, "value": 0.0, "unit": unit,
+            "vs_baseline": 0.0, "degenerate": True,
+            "platform": devices[0].platform,
+            "device_kind": devices[0].device_kind,
+            "n_devices": len(devices), "tp_size": tp_size,
+            "note": "composed fsdp×tp needs data:N>=2 × model:M>=2",
+        }
+    data_size = len(devices) // tp_size
+    mesh = make_mesh(f"data:{data_size},model:{tp_size}", devices)
+    num_heads, head_dim, mlp_dim = 4, 32, 512
+    embed = num_heads * head_dim
+    batch_size = (PER_DEVICE_BATCH or 2) * data_size
+    ids = np.random.default_rng(0).integers(0, vocab, (batch_size, seq))
+    batch = {"input_ids": jax.device_put(
+        np.asarray(ids, np.int32), NamedSharding(mesh, P("data")))}
+    config = TrainingConfig(warmup_steps=0, max_grad_norm=1000.0)
+    key = jax.random.PRNGKey(0)
+
+    def build_variant(kind):
+        model = GptDecoder(
+            vocab_size=vocab, max_len=seq, num_layers=depth,
+            num_heads=num_heads, head_dim=head_dim, mlp_dim=mlp_dim,
+            scan_layers=True, fused_head=True,
+            # FLOPs matching: the composed backward recomputes each block
+            # from its boundary activation (implicit block remat), so the
+            # default pairs with explicit remat-scan (r9 convention)
+            remat=kind == "default",
+            fsdp_overlap=kind == "composed",
+            tp_overlap=kind == "composed",
+            mesh=mesh if kind == "composed" else None)
+        task = CausalLmTask(model)
+        params, extra = task.init(key, batch)
+        tx, schedule = make_optimizer(config, total_steps=10_000)
+        state = TrainState(
+            step=jnp.zeros((), jnp.int32), params=params, extra_vars=extra,
+            opt_state=tx.init(params), rng=jax.random.clone(key),
+        )
+        state = shard_tree(state, mesh)
+        if kind in ("default", "composed"):
+            state = state.replace(
+                params=fsdp_reshard(state.params, mesh, prefer_dim=0),
+                opt_state=fsdp_reshard(state.opt_state, mesh,
+                                       prefer_dim=0))
+        compiled = make_train_step(task, tx, schedule).lower(
+            state, batch).compile()
+        return [task, compiled, state]
+
+    variants = {kind: build_variant(kind)
+                for kind in ("default", "composed")}
+
+    # -- parity leg: one optimizer step each from identical init ----------
+    stepped = {}
+    for kind, slot in variants.items():
+        new_state, metrics = slot[1](slot[2], batch)
+        stepped[kind] = (new_state, float(metrics["loss"]))
+        slot[2] = new_state  # donated input: thread the buffer
+    parity = max(
+        float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32))))
+        for a, b in zip(jax.tree.leaves(stepped["default"][0].params),
+                        jax.tree.leaves(stepped["composed"][0].params))
+    )
+
+    # -- ddp×tp probe: eval-mode loss + grads vs the replicated default ----
+    probe_model = GptDecoder(
+        vocab_size=vocab, max_len=seq, num_layers=depth,
+        num_heads=num_heads, head_dim=head_dim, mlp_dim=mlp_dim,
+        scan_layers=True, fused_head=True, ddp_overlap=True,
+        tp_overlap=True, mesh=mesh)
+    probe_task = CausalLmTask(probe_model)
+    ref_task = CausalLmTask(GptDecoder(
+        vocab_size=vocab, max_len=seq, num_layers=depth,
+        num_heads=num_heads, head_dim=head_dim, mlp_dim=mlp_dim,
+        scan_layers=True, fused_head=True))
+    probe_params, _ = ref_task.init(key, batch)
+    probe_params = nn.meta.unbox(probe_params)
+
+    def loss_of(task):
+        return jax.jit(jax.value_and_grad(
+            lambda p: task.loss(p, {}, batch, None, train=False)[0]))
+
+    lr_, gr_ = loss_of(ref_task)(probe_params)
+    lp_, gp_ = loss_of(probe_task)(probe_params)
+    ddp_tp_parity = max(
+        float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32))))
+        for a, b in zip(jax.tree.leaves(gr_), jax.tree.leaves(gp_)))
+
+    # -- HLO schedule-evidence leg ----------------------------------------
+    ev = hlo_composed_evidence(variants["composed"][1].as_text())
+
+    # -- step-time leg: alternating reps, min-of-reps ---------------------
+    for kind, slot in variants.items():
+        compiled, state = slot[1], slot[2]
+        metrics = None
+        for _ in range(max(WARMUP_STEPS - 1, 0)):
+            state, metrics = compiled(state, batch)
+        if metrics is not None:
+            float(metrics["loss"])  # drain before the clock starts
+        slot[2] = state
+    step_ms = {}
+    for rep in range(3):
+        for kind, slot in variants.items():
+            compiled, state = slot[1], slot[2]
+            t0 = time.perf_counter()
+            for _ in range(TIMED_STEPS):
+                state, metrics = compiled(state, batch)
+            loss = float(metrics["loss"])  # host read = honest fence
+            dt = time.perf_counter() - t0
+            slot[2] = state
+            assert np.isfinite(loss), f"non-finite loss {loss}"
+            ms = 1e3 * dt / TIMED_STEPS
+            step_ms[kind] = min(step_ms.get(kind, ms), ms)
+
+    # -- wire-accounting leg ----------------------------------------------
+    wires = tp_wire_bytes_per_step(
+        batch=batch_size, seq=seq, embed=embed, num_layers=depth,
+        n=tp_size, vocab=vocab)
+
+    ratio = step_ms["default"] / max(step_ms["composed"], 1e-9)
+    return {
+        "metric": metric,
+        "value": round(ratio, 3),
+        # FLOPs-matched pair (remat default vs recompute-from-boundary
+        # composed); neutrality-or-better bar: ratio >= 0.9 passes
+        "unit": unit,
+        "vs_baseline": round(ratio / 0.9, 4),
+        "platform": devices[0].platform,
+        "device_kind": devices[0].device_kind,
+        "n_devices": len(devices),
+        "degenerate": False,
+        "tp_size": tp_size,
+        "data_size": data_size,
+        "depth": depth,
+        "seq_len": seq,
+        "vocab": vocab,
+        "batch": batch_size,
+        "model_dims": {"num_heads": num_heads, "head_dim": head_dim,
+                       "mlp_dim": mlp_dim},
+        "timed_steps": TIMED_STEPS,
+        "step_time_default_ms": round(step_ms["default"], 2),
+        "step_time_composed_ms": round(step_ms["composed"], 2),
+        "loss_default": stepped["default"][1],
+        "loss_composed": stepped["composed"][1],
+        "parity_max_abs_diff": parity,
+        "loss_ddp_tp_probe": float(lp_),
+        "loss_ddp_tp_ref": float(lr_),
+        "ddp_tp_parity_max_abs_diff": ddp_tp_parity,
+        "hlo_independent_gather_bodies": ev["independent_gather_bodies"],
+        "hlo_independent_ring_bodies": ev["independent_ring_bodies"],
+        "hlo_bodies_with_both_independent":
+            len(ev["bodies_with_both_independent"]),
+        "hlo_composed_overlap_independent":
+            ev["composed_overlap_independent"],
+        "tp_wire_mb_stack": round(wires["stack"] / 1e6, 3),
+        "tp_wire_mb_head": round(wires["head"] / 1e6, 3),
+        "tp_wire_mb_per_step": round(
+            (wires["stack"] + wires["head"]) / 1e6, 3),
+    }
+
+
 def run_scaling(model: str) -> dict:
     """DDP scaling sweep: per-chip throughput on data:1/2/4/... sub-meshes.
 
@@ -1589,6 +1843,8 @@ def main() -> None:
             _emit(run_comms())
         elif MODE == "tp":
             _emit(run_tp())
+        elif MODE == "overlap3d":
+            _emit(run_overlap3d())
         elif MODE == "e2e":
             _emit(run_e2e(model, metric, unit, baseline))
         elif MODE == "train":
@@ -1596,7 +1852,7 @@ def main() -> None:
         else:  # typo'd mode must not masquerade as a train number
             raise ValueError(
                 f"unknown BENCH_MODE {MODE!r}; expected "
-                "train|e2e|scaling|flash|compile|overlap|comms|tp"
+                "train|e2e|scaling|flash|compile|overlap|comms|tp|overlap3d"
             )
     except KeyboardInterrupt:  # operator abort is not a value-0 datum
         raise

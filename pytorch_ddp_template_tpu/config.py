@@ -111,7 +111,8 @@ class TrainingConfig:
     #                           all-gather walls; the model-sharded LM
     #                           head rides the same ring (ops/lm_head.py).
     #                           Needs --scan_layers and a `model` mesh
-    #                           axis; MoE/pipe/--ddp_overlap/--fsdp refused
+    #                           axis; composes with --fsdp_overlap /
+    #                           --ddp_overlap (r11); MoE/pipe refused
     remat: bool = False  # rematerialise blocks (peak-memory for FLOPs trade;
     #                      long-context entries default it on regardless)
     scan_layers: bool = False  # drive the transformer block stack as ONE
@@ -192,17 +193,23 @@ class TrainingConfig:
                 "block is compiled once and driven over the stacked "
                 "(num_layers, ...) weights; pass both flags"
             )
-        if self.tp_overlap and self.ddp_overlap:
+        if self.tp_overlap and self.fsdp and not self.fsdp_overlap:
+            # the composed schedule needs the EXPLICIT gather pipeline:
+            # plain GSPMD FSDP leaves data-split weights that the ring
+            # region specs would silently unshard every layer
             raise ValueError(
-                "--tp_overlap cannot compose with --ddp_overlap: each "
-                "mode owns the stack's execution schedule (model-axis "
-                "rings vs per-layer data-axis reduces); pick one"
+                "--tp_overlap composes with FSDP only through "
+                "--fsdp_overlap (the explicit gather pipeline carries the "
+                "model placement through its region specs); plain --fsdp "
+                "leaves GSPMD-managed data-split weights the ring regions "
+                "cannot serve — pass --fsdp_overlap instead of --fsdp"
             )
-        if self.tp_overlap and self.fsdp:
+        if self.grad_error_feedback and self.tp_overlap:
             raise ValueError(
-                "--tp_overlap assumes weights sharded over `model` only; "
-                "--fsdp/--fsdp_overlap adds a data-axis split the ring "
-                "region specs cannot serve — pick one execution mode"
+                "--grad_error_feedback does not compose with --tp_overlap "
+                "yet: the residual leaves are sized for replicated "
+                "full-width grads, but the ddp×tp drain reduces "
+                "model-sharded slices; drop one of the two"
             )
         if self.grad_error_feedback and self.gradient_accumulation_steps > 1:
             raise ValueError(
@@ -211,6 +218,63 @@ class TrainingConfig:
                 "would need the previous one's residual sequentially, but "
                 "the accumulation scan reduces per microbatch in "
                 "parallel semantics; drop one of the two"
+            )
+
+    def validate_mesh_consistency(self) -> None:
+        """Reject overlap-flag × ``--mesh`` combinations that can never
+        build, at parse time and with the reason named — instead of
+        failing deep inside shard_map spec construction after model init.
+
+        Syntactic check on the mesh *spec string* (no devices needed):
+        an axis is treated as live when its size is > 1 or the ``-1``
+        wildcard (which could resolve to > 1; the runtime validators
+        still catch a wildcard that lands on 1). Called by
+        :func:`parse_args`; programmatic ``TrainingConfig`` construction
+        with an externally-built mesh is validated at build time instead
+        (``models/registry.py``).
+        """
+        if not (self.fsdp_overlap or self.ddp_overlap or self.tp_overlap):
+            return
+        flags = "/".join(
+            f for f, on in (("--fsdp_overlap", self.fsdp_overlap),
+                            ("--ddp_overlap", self.ddp_overlap),
+                            ("--tp_overlap", self.tp_overlap)) if on)
+        axes: dict[str, int] = {}
+        for part in self.mesh.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, size_s = part.partition(":")
+            try:
+                axes[name] = int(size_s) if size_s else -1
+            except ValueError:
+                return  # malformed spec: leave it to parse_mesh_spec
+        live = {n: s for n, s in axes.items() if s == -1 or s > 1}
+        extra = {n: s for n, s in live.items()
+                 if n not in ("data", "model")}
+        if extra:
+            raise ValueError(
+                f"{flags} composes over data×model only, but --mesh "
+                f"{self.mesh!r} has live axes {extra} — drop those axes "
+                "or the overlap flags"
+            )
+        if self.tp_overlap and "model" not in live:
+            raise ValueError(
+                f"--tp_overlap decomposes model-axis collectives, but "
+                f"--mesh {self.mesh!r} has no live model axis — add "
+                "model:N (N>=2) to --mesh or drop --tp_overlap"
+            )
+        if "model" in live and not self.tp_overlap:
+            which = ("--fsdp_overlap" if self.fsdp_overlap
+                     else "--ddp_overlap")
+            why = ("model-sharded weights the gather region specs would "
+                   "silently unshard" if self.fsdp_overlap else
+                   "model-sharded (not replicated) params the reduce "
+                   "region specs would silently unshard")
+            raise ValueError(
+                f"{which} on --mesh {self.mesh!r}: a live model axis "
+                f"means {why} — pass --tp_overlap too (the composed "
+                "schedule) or drop the model axis"
             )
 
     @property
@@ -377,8 +441,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "The model-sharded LM head accumulates per-shard "
                         "partial logits around the same ring (fused_head "
                         "is turned on for LM families). Requires "
-                        "--scan_layers and a model:N mesh axis; MoE/pipe/"
-                        "--ddp_overlap/--fsdp refused.")
+                        "--scan_layers and a model:N mesh axis. Composes "
+                        "with --fsdp_overlap (gathers carry the model "
+                        "placement) and --ddp_overlap (one data x model "
+                        "region, merged grad drain); plain --fsdp and "
+                        "MoE/pipe refused.")
     p.add_argument("--fused_head", action="store_true",
                    help="Compute the LM head blockwise over the vocab "
                         "(ops/lm_head.py): the (B,T,V) logits tensor never "
@@ -478,4 +545,9 @@ def parse_args(argv: list[str] | None = None) -> TrainingConfig:
     else:
         ns.preempt_sync_steps = 8  # dataclass default, for config dumps
     known = {f.name for f in dataclasses.fields(TrainingConfig)}
-    return TrainingConfig(**{k: v for k, v in vars(ns).items() if k in known})
+    config = TrainingConfig(
+        **{k: v for k, v in vars(ns).items() if k in known})
+    # overlap-flag × mesh inconsistencies fail HERE with named reasons,
+    # not deep inside shard_map spec construction after model init
+    config.validate_mesh_consistency()
+    return config

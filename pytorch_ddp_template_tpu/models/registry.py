@@ -114,7 +114,9 @@ def build(name: str, config: TrainingConfig, mesh=None) -> tuple[Task, Dataset]:
 
         if mesh is None:
             mesh = make_mesh(config.mesh, jax.devices())
-        validate_overlap_mesh(mesh)  # fail fast, before any tracing
+        # fail fast, before any tracing; tp=True (fsdp×tp composition)
+        # admits the model axis the gather specs will carry
+        validate_overlap_mesh(mesh, tp=config.tp_overlap)
         task.model = task.model.clone(fsdp_overlap=True, mesh=mesh)
     if config.ddp_overlap:
         if not config.scan_layers:
@@ -143,7 +145,9 @@ def build(name: str, config: TrainingConfig, mesh=None) -> tuple[Task, Dataset]:
 
         if mesh is None:
             mesh = make_mesh(config.mesh, jax.devices())
-        validate_ddp_mesh(mesh)  # fail fast, before any tracing
+        # fail fast, before any tracing; tp=True (ddp×tp composition)
+        # moves the region onto data×model with the local ring kernels
+        validate_ddp_mesh(mesh, tp=config.tp_overlap)
         task.model = task.model.clone(
             ddp_overlap=True, mesh=mesh, grad_comm=config.grad_comm,
             grad_error_feedback=config.grad_error_feedback)

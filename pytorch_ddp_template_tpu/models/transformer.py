@@ -124,9 +124,17 @@ class MultiHeadAttention(nn.Module):
     # projections) and the out projection a matmul-reduce-scatter ring
     # (parallel/collective_matmul.py); param tree unchanged
     tp_overlap: bool = False
+    # tp_local: the caller already traces this module INSIDE a shard_map
+    # region covering the `model` axis (the ddp×tp composed schedule,
+    # parallel/schedule.py) — run the same ring kernels per-shard with no
+    # second region; num_heads/head_dim still describe the GLOBAL
+    # geometry, the local arrays carry the per-shard slices
+    tp_local: bool = False
 
     def _tp_qkv(self, x):
-        from ..parallel.collective_matmul import tp_column_dense
+        from ..parallel.collective_matmul import (
+            tp_column_dense, tp_column_dense_local,
+        )
 
         embed = x.shape[-1]
         params = [
@@ -140,18 +148,26 @@ class MultiHeadAttention(nn.Module):
             return [_plain_dense(x, k, b, 1, self.dtype)
                     for k, b in params]
         x = x.astype(self.dtype)
-        return tp_column_dense(
-            x, [k.astype(self.dtype) for k in kernels],
-            [b.astype(self.dtype) for b in biases], self.mesh)
+        kernels = [k.astype(self.dtype) for k in kernels]
+        biases = [b.astype(self.dtype) for b in biases]
+        if self.tp_local:
+            return tp_column_dense_local(x, kernels, biases)
+        return tp_column_dense(x, kernels, biases, self.mesh)
 
     def _tp_out(self, out, features):
-        from ..parallel.collective_matmul import tp_row_dense
+        from ..parallel.collective_matmul import (
+            tp_row_dense, tp_row_dense_local,
+        )
 
         kernel, bias = _DenseParams(
             (self.num_heads, self.head_dim), (features,),
             ("heads", "kv", "embed"), name="out")()
         if self.is_initializing():
             return _plain_dense(out, kernel, bias, 2, self.dtype)
+        if self.tp_local:
+            return tp_row_dense_local(out.astype(self.dtype),
+                                      kernel.astype(self.dtype),
+                                      bias.astype(self.dtype))
         return tp_row_dense(out.astype(self.dtype),
                             kernel.astype(self.dtype),
                             bias.astype(self.dtype), self.mesh)
@@ -236,6 +252,7 @@ class MlpBlock(nn.Module):
     dropout_rate: float = 0.0
     act: Callable = nn.gelu
     tp_overlap: bool = False
+    tp_local: bool = False  # already inside a model-axis shard_map region
     mesh: jax.sharding.Mesh | None = None
 
     @nn.compact
@@ -243,13 +260,18 @@ class MlpBlock(nn.Module):
         features = x.shape[-1]
         if self.tp_overlap:
             from ..parallel.collective_matmul import (
-                tp_column_dense, tp_row_dense,
+                tp_column_dense, tp_column_dense_local, tp_row_dense,
+                tp_row_dense_local,
             )
 
             k1, b1 = _DenseParams((features,), (self.mlp_dim,),
                                   ("embed", "mlp"), name="fc1")()
             if self.is_initializing():
                 h = _plain_dense(x, k1, b1, 1, self.dtype)
+            elif self.tp_local:
+                (h,) = tp_column_dense_local(
+                    x.astype(self.dtype), [k1.astype(self.dtype)],
+                    [b1.astype(self.dtype)])
             else:
                 (h,) = tp_column_dense(
                     x.astype(self.dtype), [k1.astype(self.dtype)],
@@ -259,6 +281,10 @@ class MlpBlock(nn.Module):
                                   ("mlp", "embed"), name="fc2")()
             if self.is_initializing():
                 h = _plain_dense(h, k2, b2, 1, self.dtype)
+            elif self.tp_local:
+                h = tp_row_dense_local(h.astype(self.dtype),
+                                       k2.astype(self.dtype),
+                                       b2.astype(self.dtype))
             else:
                 h = tp_row_dense(h.astype(self.dtype),
                                  k2.astype(self.dtype),
@@ -286,6 +312,9 @@ class EncoderBlock(nn.Module):
     causal: bool = False
     moe_experts: int = 0  # >0: FFN = top-1 MoE over this many experts
     tp_overlap: bool = False  # ring-decomposed TP matmuls (qkv/out/fc1/fc2)
+    tp_local: bool = False  # already inside a model-axis shard_map region
+    #                         (the ddp×tp composed schedule): geometry
+    #                         fields then describe the PER-SHARD slice
 
     @nn.compact
     def __call__(self, x, mask=None, train: bool = True):
@@ -295,7 +324,7 @@ class EncoderBlock(nn.Module):
         attn = MultiHeadAttention(
             self.num_heads, self.head_dim, self.dtype,
             self.dropout_rate, self.attn_impl, self.mesh, self.causal,
-            tp_overlap=self.tp_overlap,
+            tp_overlap=self.tp_overlap, tp_local=self.tp_local,
             name="attention",
         )
         if self.moe_experts:
@@ -306,7 +335,8 @@ class EncoderBlock(nn.Module):
                               name="mlp")
         else:
             mlp = MlpBlock(self.mlp_dim, self.dtype, self.dropout_rate,
-                           tp_overlap=self.tp_overlap, mesh=self.mesh,
+                           tp_overlap=self.tp_overlap,
+                           tp_local=self.tp_local, mesh=self.mesh,
                            name="mlp")
         if self.pre_norm:
             x = x + attn(ln("ln_attn")(x).astype(self.dtype), mask, train=train)
@@ -400,11 +430,12 @@ class TransformerEncoder(nn.Module):
                 "expert dispatch needs in-region handling); drop one of "
                 "the two"
             )
-        if self.fsdp_overlap or self.ddp_overlap:
+        if self.ddp_overlap and self._ef_active:
             raise ValueError(
-                "--tp_overlap cannot compose with --fsdp_overlap/"
-                "--ddp_overlap: each mode owns the stack's execution "
-                "schedule; pick one"
+                "--grad_error_feedback does not compose with --tp_overlap "
+                "yet: the residual leaves are sized for replicated "
+                "full-width grads, but the ddp×tp drain reduces "
+                "model-sharded slices; drop one of the two"
             )
         if self.attn_impl in ("ring", "ulysses"):
             raise ValueError(
@@ -460,15 +491,20 @@ class TransformerEncoder(nn.Module):
         """Drive the stacked block via ``parallel.compress.ddp_overlap_scan``:
         same replicated weights, same math, but each layer's grad reduce
         happens inside its own backward iteration in ``grad_comm`` wire
-        precision. Numerics match the nn.scan path to reduction
+        precision. Composed with ``tp_overlap`` the region covers
+        ``data × model``, the block runs the LOCAL ring kernels
+        (``tp_local`` — geometry scaled to the per-shard slice), and each
+        layer's drain merges TP's ``data``-psum of weight grads with the
+        bucket reduce. Numerics match the nn.scan path to reduction
         reassociation under fp32 comms and dropout-free training; with
         dropout active each replica folds the layer index and its data-
-        axis coordinate into the stream (statistically equivalent, not
-        bit-interchangeable — documented in README)."""
+        (and under tp, model-) axis coordinate into the stream
+        (statistically equivalent, not bit-interchangeable — documented
+        in README)."""
         from jax.sharding import PartitionSpec as P
 
         from ..parallel.compress import ddp_overlap_scan, validate_ddp_mesh
-        from ..runtime.context import DATA_AXIS
+        from ..runtime.context import DATA_AXIS, MODEL_AXIS
 
         if self.moe_experts:
             raise ValueError(
@@ -476,7 +512,7 @@ class TransformerEncoder(nn.Module):
                 "sown load-balance losses and expert dispatch need "
                 "in-region handling); drop one of the two"
             )
-        validate_ddp_mesh(self.mesh)
+        validate_ddp_mesh(self.mesh, tp=self.tp_overlap)
         stacked = nn.meta.unbox(
             self.scope.get_variable("params", SCAN_LAYER_AXIS))
         if stacked is None:
@@ -485,10 +521,22 @@ class TransformerEncoder(nn.Module):
                 f"'{SCAN_LAYER_AXIS}' params — was the model initialised "
                 "with scan_layers?"
             )
+        tp_specs = None
+        tp_n = 1
+        if self.tp_overlap:
+            from ..parallel.schedule import stacked_tp_specs
+
+            tp_specs = stacked_tp_specs(stacked, self.mesh)
+            tp_n = self.mesh.shape[MODEL_AXIS]
         block = block_cls(
-            self.num_heads, self.head_dim, self.mlp_dim, self.dtype,
+            # under tp the block traces INSIDE the region: its geometry
+            # fields must describe the per-shard slice (flax validates
+            # param shapes at apply against these)
+            self.num_heads // tp_n, self.head_dim,
+            self.mlp_dim // tp_n, self.dtype,
             self.dropout_rate, self.pre_norm, self.attn_impl, self.mesh,
             self.causal, moe_experts=self.moe_experts,
+            tp_overlap=self.tp_overlap, tp_local=self.tp_overlap,
             parent=None, name=SCAN_LAYER_AXIS,
         )
         lossy = self.grad_comm != "fp32"
@@ -524,8 +572,12 @@ class TransformerEncoder(nn.Module):
                 # per-layer, per-replica dropout stream: apply_one runs
                 # inside the shard_map region, so the axis fold gives
                 # each replica its own mask over its own batch shard
+                # (and, composed with tp, its own seq chunk)
                 rr = jax.random.fold_in(jax.random.fold_in(r, k),
                                         jax.lax.axis_index(DATA_AXIS))
+                if self.tp_overlap:
+                    rr = jax.random.fold_in(
+                        rr, jax.lax.axis_index(MODEL_AXIS))
                 rngs = {"dropout": rr}
             # positional train: the remat wrapper pins it static via
             # static_argnums=(3,) (self counts as argnum 0)
@@ -543,36 +595,53 @@ class TransformerEncoder(nn.Module):
             # (and anyone differentiating an eval-mode loss gets exact
             # grads, which is what a probe wants)
             grad_comm=self.grad_comm if train else "fp32",
-            residual=residual, comm_rng=comm_rng)
+            residual=residual, comm_rng=comm_rng, tp_specs=tp_specs)
 
     def _overlap_forward(self, block_cls, x, mask, train):
-        """Drive the stacked block via ``parallel.overlap.overlap_scan``
-        instead of ``nn.scan``: same weights, same math, explicit
-        prefetch schedule. Numerics match the nn.scan path bit-for-bit in
-        eval mode and dropout-free training; with dropout active the
-        per-layer streams are folded from the layer index rather than
-        nn.scan's split — statistically equivalent, not bit-identical."""
-        from ..parallel.overlap import overlap_scan, validate_overlap_mesh
+        """Drive the stacked block through the unified decomposed scan at
+        the GSPMD level: ``fsdp_overlap`` (± ``tp_overlap``) rides
+        ``parallel.overlap.overlap_scan`` (the fsdp gather/scatter
+        schedule, with the Megatron model placement threaded through the
+        region specs when composed), ``tp_overlap`` alone rides the null
+        weight schedule (``parallel.schedule.PlainSchedule``) — the
+        block's own ring collective matmuls carry the model-axis
+        overlap, and the per-layer backward structure drains each
+        layer's ``data``-psum of TP weight grads inside its own
+        iteration. Numerics match the nn.scan path bit-for-bit in eval
+        mode and dropout-free training (TP rows to ring reassociation);
+        with dropout active the per-layer streams are folded from the
+        layer index rather than nn.scan's split — statistically
+        equivalent, not bit-identical."""
+        from ..parallel.overlap import overlap_scan
 
+        flag = "--fsdp_overlap" if self.fsdp_overlap else "--tp_overlap"
         if self.moe_experts:
             raise ValueError(
-                "--fsdp_overlap does not compose with MoE blocks yet (the "
+                f"{flag} does not compose with MoE blocks yet (the "
                 "sown load-balance losses and expert dispatch need "
                 "in-region handling); drop one of the two"
             )
-        validate_overlap_mesh(self.mesh)
         stacked = nn.meta.unbox(
             self.scope.get_variable("params", SCAN_LAYER_AXIS))
         if stacked is None:
             raise ValueError(
-                "fsdp_overlap apply found no stacked "
+                f"{flag} apply found no stacked "
                 f"'{SCAN_LAYER_AXIS}' params — was the model initialised "
                 "with scan_layers?"
             )
+        tp_specs = None
+        if self.tp_overlap and self.fsdp_overlap:
+            # only the gather/scatter specs consume the TP placement;
+            # tp-alone (PlainSchedule) slices replicated-over-data
+            # weights and needs no spec table
+            from ..parallel.schedule import stacked_tp_specs
+
+            tp_specs = stacked_tp_specs(stacked, self.mesh)
         block = block_cls(
             self.num_heads, self.head_dim, self.mlp_dim, self.dtype,
             self.dropout_rate, self.pre_norm, self.attn_impl, self.mesh,
             self.causal, moe_experts=self.moe_experts,
+            tp_overlap=self.tp_overlap,
             parent=None, name=SCAN_LAYER_AXIS,
         )
         dropout_rng = None
@@ -592,8 +661,15 @@ class TransformerEncoder(nn.Module):
 
         # mask/rng ride as explicit custom_vjp args (tracers must not be
         # closed over); None entries vanish from the pytree harmlessly
-        return overlap_scan(apply_one, stacked, x, (mask, dropout_rng),
-                            self.mesh)
+        if self.fsdp_overlap:
+            return overlap_scan(apply_one, stacked, x, (mask, dropout_rng),
+                                self.mesh, tp_specs=tp_specs)
+        from ..parallel.collective_matmul import validate_tp_mesh
+        from ..parallel.schedule import PlainSchedule, decomposed_scan
+
+        validate_tp_mesh(self.mesh)
+        return decomposed_scan(PlainSchedule(), apply_one, stacked, x,
+                               (mask, dropout_rng))
 
     @nn.compact
     def __call__(self, x, mask=None, *, train: bool = True):
@@ -608,6 +684,12 @@ class TransformerEncoder(nn.Module):
                     return self._overlap_forward(block_cls, x, mask, train)
                 if self.ddp_overlap:
                     return self._ddp_forward(block_cls, x, mask, train)
+                if self.tp_overlap:
+                    # tp alone also rides the unified decomposed scan
+                    # (PlainSchedule): one scanned body whose per-layer
+                    # backward drains each layer's TP weight-grad psum
+                    # inside its own iteration
+                    return self._overlap_forward(block_cls, x, mask, train)
             block = block_cls(
                 self.num_heads, self.head_dim, self.mlp_dim, self.dtype,
                 self.dropout_rate, self.pre_norm, self.attn_impl, self.mesh,

@@ -22,6 +22,15 @@ from .expert import expert_apply, stack_expert_params
 from .overlap import hlo_overlap_evidence, overlap_scan, validate_overlap_mesh
 from .pipeline import pipeline_apply, stack_stage_params
 from .ring import ring_attention, ring_attention_local
+from .schedule import (
+    DdpSchedule,
+    FsdpSchedule,
+    PlainSchedule,
+    decomposed_scan,
+    hlo_composed_evidence,
+    stacked_tp_specs,
+    validate_schedule_mesh,
+)
 from .sharding import (
     DEFAULT_RULES,
     active_rules,
@@ -36,10 +45,17 @@ from .ulysses import ulysses_attention
 
 __all__ = [
     "DEFAULT_RULES",
+    "DdpSchedule",
+    "FsdpSchedule",
+    "PlainSchedule",
     "active_rules",
     "compressed_allreduce",
     "ddp_overlap_scan",
+    "decomposed_scan",
     "describe",
+    "hlo_composed_evidence",
+    "stacked_tp_specs",
+    "validate_schedule_mesh",
     "expert_apply",
     "hlo_comms_evidence",
     "validate_ddp_mesh",

@@ -315,6 +315,17 @@ def tp_column_dense(x: jax.Array, kernels: Sequence[jax.Array],
     return list(out)
 
 
+def tp_column_dense_local(x_c: jax.Array, kernels: Sequence[jax.Array],
+                          biases: Sequence[jax.Array]) -> list[jax.Array]:
+    """Local (per-shard) form of :func:`tp_column_dense` for callers
+    ALREADY inside a ``shard_map`` region that includes the ``model``
+    axis (the ddp×tp composed schedule, ``parallel/schedule.py``): the
+    same ring kernel, same custom_vjp backward, no second region. Inputs
+    are the per-shard chunks — ``x_c`` the held seq chunk ``(B_l, t,
+    E)``, kernels/biases the local feature shards."""
+    return list(_col_local(x_c, tuple(kernels), tuple(biases)))
+
+
 # -- row op: y = RS(h @ w) + b (fc2 / out projection) ----------------------
 
 def _row_math(h_l, w_l, b):
@@ -402,6 +413,16 @@ def tp_row_dense(h: jax.Array, kernel: jax.Array, bias: jax.Array,
     return shard_map(_row_local, mesh=mesh,
                      in_specs=(h_spec, k_spec, P()),
                      out_specs=y_spec, check_vma=False)(h, kernel, bias)
+
+
+def tp_row_dense_local(h_l: jax.Array, kernel: jax.Array,
+                       bias: jax.Array) -> jax.Array:
+    """Local (per-shard) form of :func:`tp_row_dense` for callers ALREADY
+    inside a ``shard_map`` region that includes the ``model`` axis (the
+    ddp×tp composed schedule): ``h_l`` is the local contraction shard
+    ``(B_l, T, K_l, *rest)``, ``kernel`` the local row shard, ``bias``
+    replicated (added once per reduced chunk, as in the region form)."""
+    return _row_local(h_l, kernel, bias)
 
 
 # -- wire accounting -------------------------------------------------------

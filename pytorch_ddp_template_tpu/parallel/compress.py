@@ -66,7 +66,6 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..runtime.context import DATA_AXIS
-from .overlap import _zero_cotangent
 from .shard_map_compat import shard_map
 
 #: supported wire formats for the per-layer gradient exchange
@@ -78,29 +77,17 @@ GRAD_COMM_MODES = ("fp32", "bf16", "int8")
 CHUNK = 256
 
 
-def validate_ddp_mesh(mesh: Mesh | None) -> Mesh:
+def validate_ddp_mesh(mesh: Mesh | None, tp: bool = False) -> Mesh:
     """Refuse meshes the compressed-DDP path cannot serve, with intent.
 
-    The reduce regions exchange gradients over ``data`` only and assume
-    replicated weights; a live ``model``/``seq``/... axis means the
-    params are not replicated and the region specs would silently
-    unshard them.
+    Delegates to the unified ``schedule.validate_schedule_mesh``:
+    replicated-param data-only meshes alone, or data×model when composed
+    with the TP ring schedule (``tp=True`` — the reduce region then runs
+    over both axes with the block's local ring kernels inside it).
     """
-    if mesh is None:
-        raise ValueError(
-            "--ddp_overlap needs the device mesh threaded into the model "
-            "(models/registry.py does this; pass mesh= when building "
-            "directly)"
-        )
-    extra = {name: size for name, size in mesh.shape.items()
-             if name != DATA_AXIS and size > 1}
-    if extra:
-        raise ValueError(
-            f"--ddp_overlap supports replicated-param data-parallel meshes "
-            f"only; mesh also has {extra} — drop the extra axes or drop "
-            "--ddp_overlap"
-        )
-    return mesh
+    from .schedule import validate_schedule_mesh
+
+    return validate_schedule_mesh(mesh, ddp=True, tp=tp)
 
 
 # -- quantizers ------------------------------------------------------------
@@ -322,11 +309,6 @@ def compressed_allreduce(partials: Any, mesh: Mesh, mode: str, *,
 # -- the scan: per-layer backward with in-iteration compressed reduce ------
 
 
-def _slice_layer(stacked: Any, k: jax.Array) -> Any:
-    return jax.tree.map(
-        lambda a: lax.dynamic_index_in_dim(a, k, 0, keepdims=False), stacked)
-
-
 def ddp_overlap_scan(apply_fn: Callable[[Any, jax.Array, jax.Array, Any],
                                         jax.Array],
                      stacked: Any, x: jax.Array, extras: Any,
@@ -334,115 +316,39 @@ def ddp_overlap_scan(apply_fn: Callable[[Any, jax.Array, jax.Array, Any],
                      grad_comm: str = "fp32",
                      residual: Any | None = None,
                      comm_rng: jax.Array | None = None,
-                     chunk: int = CHUNK) -> jax.Array:
+                     chunk: int = CHUNK,
+                     tp_specs: Any | None = None) -> jax.Array:
     """Run ``apply_fn(layer_params, y, k, extras)`` over the stacked
     layers with per-layer cross-replica grad reduces issued inside the
     backward scan iteration, in ``grad_comm`` wire precision.
 
-    ``apply_fn`` is traced INSIDE a ``shard_map`` region over ``data``
-    in both directions: it sees the per-replica batch shard, so the
-    gradients its vjp produces are true per-replica partials — the
-    quantity a compressed reduce must start from (at the GSPMD level
-    partials are unobservable: any replicated consumer triggers the
-    implicit fp32 psum). ``extras`` rides as an explicit primal
-    (custom_vjp forbids closing over tracers) with ``extras_specs``
-    giving each leaf's region spec (batch-sharded mask vs replicated
-    rng).
+    Since round 11 this is a thin wrapper assembling the ddp
+    contribution (:class:`parallel.schedule.DdpSchedule`: the whole
+    per-layer block vjp inside a ``shard_map`` region over ``data`` —
+    the only level where unreduced per-replica partials are observable —
+    with that layer's compressed reduce issued in the same iteration)
+    onto the ONE shared custom-vjp skeleton
+    (``parallel.schedule.decomposed_scan``). Same signature, same
+    numerics as the r9 original; ``extras_specs`` gives each extras
+    leaf's region spec (batch-sharded mask vs replicated rng), and
+    ``residual``/``comm_rng`` thread the error-feedback state whose
+    update leaves through the residual input's cotangent slot.
 
-    Forward: a plain ``lax.scan`` saving only the layer-boundary
-    activations. Backward (the custom-vjp rule): a reverse scan whose
-    body recomputes layer k's block from the saved boundary activation
-    (implicit block remat, as in ``overlap_scan``), vjps it locally, and
-    reduces that layer's grads immediately — each iteration's reduce
-    consumes only its own layer's compute, so the scheduler may drain it
-    while layer k-1's backward runs. With ``residual`` (error feedback),
-    each layer's residual slice is compensated and its update returned
-    through the residual input's cotangent slot.
+    ``tp_specs`` (ddp×tp composition) switches the region to
+    ``data × model``: ``apply_fn`` must then use the LOCAL ring kernels
+    (the encoder's ``tp_local`` path), and each layer's drain merges
+    TP's ``data``-psum of weight grads with the compressed bucket reduce
+    into one exchange.
     """
-    validate_ddp_mesh(mesh)
-    if grad_comm not in GRAD_COMM_MODES:
-        raise ValueError(f"unknown grad_comm mode {grad_comm!r}; "
-                         f"expected one of {GRAD_COMM_MODES}")
-    if grad_comm != "fp32" and comm_rng is None:
-        raise ValueError(f"grad_comm={grad_comm!r} needs comm_rng for "
-                         "stochastic rounding")
-    if residual is not None and grad_comm == "fp32":
-        raise ValueError("error-feedback residual with grad_comm=fp32 is "
-                         "a no-op by construction; drop one of the two")
-    n = mesh.shape.get(DATA_AXIS, 1)
-    leaves = jax.tree.leaves(stacked)
-    if not leaves:
-        raise ValueError("ddp_overlap_scan: empty stacked parameter tree")
-    num_layers = int(leaves[0].shape[0])
-    ks = jnp.arange(num_layers, dtype=jnp.int32)
+    from .schedule import DdpSchedule, decomposed_scan, num_stacked_layers
 
-    rep = lambda tree: jax.tree.map(lambda _: P(), tree)
-    layer_specs = rep(_slice_layer(stacked, jnp.asarray(0)))
-    x_spec = P(DATA_AXIS)
-    res_slice = (None if residual is None
-                 else _slice_layer(residual, jnp.asarray(0)))
-    res_specs = jax.tree.map(lambda _: P(DATA_AXIS), res_slice)
-
-    fwd_apply = shard_map(
-        lambda w, y, k, e: apply_fn(w, y, k, e),
-        mesh=mesh, in_specs=(layer_specs, x_spec, P(), extras_specs),
-        out_specs=x_spec, check_vma=False)
-
-    def _bwd_region(w, x_k, gy, k, e, res_k, key):
-        # the whole per-layer vjp runs on the local shard: every op in
-        # the block is per-example, so these are the true local partials
-        _, pull = jax.vjp(lambda w_, y_: apply_fn(w_, y_, k, e), w, x_k)
-        gw, gx = pull(gy)
-        gw_sum, res_new = _reduce_tree(gw, res_k, key, grad_comm,
-                                       DATA_AXIS, n, chunk)
-        return gw_sum, gx, res_new
-
-    bwd_apply = shard_map(
-        _bwd_region, mesh=mesh,
-        in_specs=(layer_specs, x_spec, x_spec, P(), extras_specs,
-                  res_specs, None if comm_rng is None else P()),
-        out_specs=(layer_specs, x_spec, res_specs), check_vma=False)
-
-    @jax.custom_vjp
-    def run(stacked, x, extras, residual, comm_rng):
-        def body(y, k):
-            return fwd_apply(_slice_layer(stacked, k), y, k, extras), None
-
-        y, _ = lax.scan(body, x, ks)
-        return y
-
-    def run_fwd(stacked, x, extras, residual, comm_rng):
-        def body(y, k):
-            y_out = fwd_apply(_slice_layer(stacked, k), y, k, extras)
-            # save each layer's INPUT activation — the only O(L)
-            # residual; blocks recompute from it in backward
-            return y_out, y
-
-        y, acts = lax.scan(body, x, ks)
-        return y, (stacked, acts, extras, residual, comm_rng)
-
-    def run_bwd(res, gy):
-        stacked, acts, extras, residual, comm_rng = res
-
-        def body(gy, inputs):
-            k, x_k, res_k = inputs
-            key_k = (None if comm_rng is None
-                     else jax.random.fold_in(comm_rng, k))
-            gw_sum, gx, res_new = bwd_apply(
-                _slice_layer(stacked, k), x_k, gy, k, extras, res_k, key_k)
-            # per-layer drain: gw_sum is fully reduced HERE, inside the
-            # iteration — independent of every earlier layer's backward
-            return gx, (gw_sum, res_new)
-
-        gx, (gws, new_res) = lax.scan(
-            body, gy, (ks, acts, residual), reverse=True)
-        res_ct = new_res if residual is not None else None
-        key_ct = (None if comm_rng is None
-                  else np.zeros(np.shape(comm_rng), jax.dtypes.float0))
-        return gws, gx, _zero_cotangent(extras), res_ct, key_ct
-
-    run.defvjp(run_fwd, run_bwd)
-    return run(stacked, x, extras, residual, comm_rng)
+    num_layers = num_stacked_layers(stacked, "ddp_overlap_scan")
+    schedule = DdpSchedule(
+        mesh, stacked, num_layers, extras_specs, grad_comm=grad_comm,
+        chunk=chunk, tp_specs=tp_specs, residual=residual,
+        comm_rng=comm_rng)
+    return decomposed_scan(schedule, apply_fn, stacked, x, extras,
+                           residual=residual, comm_rng=comm_rng)
 
 
 # -- evidence --------------------------------------------------------------

@@ -78,44 +78,51 @@ from .sharding import fsdp_split_dim
 UNSPLIT = -1
 
 
-def validate_overlap_mesh(mesh: Mesh | None) -> Mesh:
+def validate_overlap_mesh(mesh: Mesh | None, tp: bool = False) -> Mesh:
     """Refuse meshes the decomposed path cannot serve, with intent.
 
-    The gather/scatter regions replicate weights over ``data`` only; a
-    live ``model``/``seq``/... axis would be silently un-sharded by the
-    replicated out-specs — TP composed with decomposed FSDP needs
-    within-region handling this v1 does not implement.
+    Delegates to the unified ``schedule.validate_schedule_mesh``:
+    data-only meshes alone, or data×model when composed with the TP ring
+    schedule (``tp=True`` — the gather/scatter region specs then carry
+    the model placement instead of silently unsharding it).
     """
-    if mesh is None:
-        raise ValueError(
-            "--fsdp_overlap needs the device mesh threaded into the model "
-            "(models/registry.py does this; pass mesh= when building "
-            "directly)"
-        )
-    extra = {name: size for name, size in mesh.shape.items()
-             if name != DATA_AXIS and size > 1}
-    if extra:
-        raise ValueError(
-            f"--fsdp_overlap supports data-axis FSDP only; mesh also has "
-            f"{extra} — drop the extra axes or drop --fsdp_overlap"
-        )
-    return mesh
+    from .schedule import validate_schedule_mesh
+
+    return validate_schedule_mesh(mesh, fsdp=True, tp=tp)
 
 
-def overlap_split_dims(stacked: Any, data_size: int) -> Any:
+def overlap_split_dims(stacked: Any, data_size: int,
+                       tp_specs: Any | None = None) -> Any:
     """Static per-leaf FSDP split dims for a stacked ``(L, ...)`` tree.
 
     Mirrors ``fsdp_reshard(prefer_dim=0)`` leaf-for-leaf via the shared
     :func:`fsdp_split_dim` chooser; ``UNSPLIT`` marks replicated leaves.
+    ``tp_specs`` (fsdp×tp) masks out the dims already carrying the
+    ``model`` axis, exactly as ``fsdp_reshard``'s placed-sharding walk
+    skips them — the chooser and the placement must agree or every
+    gather would silently reshard.
     """
-    return jax.tree.map(
-        lambda x: (lambda d: UNSPLIT if d is None else d)(
-            fsdp_split_dim(x.shape, data_size, prefer_dim=0)),
-        stacked,
-    )
+    if tp_specs is None:
+        return jax.tree.map(
+            lambda x: (lambda d: UNSPLIT if d is None else d)(
+                fsdp_split_dim(x.shape, data_size, prefer_dim=0)),
+            stacked,
+        )
+
+    def pick(x, spec):
+        entries = list(tuple(spec or ())) + [None] * x.ndim
+        free = [entries[i] is None for i in range(x.ndim)]
+        d = fsdp_split_dim(x.shape, data_size, prefer_dim=0, free=free)
+        return UNSPLIT if d is None else d
+
+    from jax.sharding import PartitionSpec
+
+    return jax.tree.map(pick, stacked, tp_specs,
+                        is_leaf=lambda v: isinstance(v, PartitionSpec))
 
 
 def make_layer_gather(mesh: Mesh, stacked: Any, num_layers: int,
+                      tp_specs: Any | None = None,
                       ) -> tuple[Callable[[Any, jax.Array], Any],
                                  Callable[[Any, jax.Array], Any]]:
     """Build the ``(gather, scatter)`` pair for one stacked layer tree.
@@ -134,16 +141,31 @@ def make_layer_gather(mesh: Mesh, stacked: Any, num_layers: int,
     state the trainer placed the region boundary is a no-op reshard.
     """
     data_size = mesh.shape.get(DATA_AXIS, 1)
-    dims = overlap_split_dims(stacked, data_size)
+    dims = overlap_split_dims(stacked, data_size, tp_specs)
+    if tp_specs is None:
+        tp_base = jax.tree.map(lambda x: P(*([None] * x.ndim)), stacked)
+    else:
+        tp_base = tp_specs
 
-    def leaf_spec(x, d):
-        spec: list[Any] = [None] * x.ndim
+    def leaf_spec(x, d, tp_sp):
+        # start from the TP placement (model axis on its Megatron dims,
+        # or all-None without tp) and add the data split on top: the
+        # region boundary is then a no-op reshard on a trainer-placed
+        # state in BOTH regimes
+        spec: list[Any] = list(tuple(tp_sp or ())) + [None] * x.ndim
+        spec = spec[: x.ndim]
         if d != UNSPLIT:
             spec[d] = DATA_AXIS
         return P(*spec)
 
-    in_specs = jax.tree.map(leaf_spec, stacked, dims)
-    rep_specs = jax.tree.map(lambda _: P(), stacked)
+    def gathered_spec(x, tp_sp):
+        # the gather drops the leading stacked layer dim; the model
+        # placement shifts left with it (data is gathered away)
+        spec: list[Any] = list(tuple(tp_sp or ()))[1:] + [None] * x.ndim
+        return P(*spec[: x.ndim - 1])
+
+    in_specs = jax.tree.map(leaf_spec, stacked, dims, tp_base)
+    rep_specs = jax.tree.map(gathered_spec, stacked, tp_base)
 
     def _gather_leaf(local: jax.Array, k: jax.Array, d: int) -> jax.Array:
         if d == 0:
@@ -209,100 +231,33 @@ def _zero_cotangent(tree: Any) -> Any:
 def overlap_scan(apply_fn: Callable[[Any, jax.Array, jax.Array, Any],
                                     jax.Array],
                  stacked: Any, x: jax.Array, extras: Any,
-                 mesh: Mesh) -> jax.Array:
+                 mesh: Mesh, tp_specs: Any | None = None) -> jax.Array:
     """Run ``apply_fn(layer_params, x, k, extras)`` over the stacked
     layers with a one-layer-ahead gather pipeline and a hand-written
     (custom-vjp) backward.
 
-    Forward: the scan carry holds ``(activations, gathered weights for
-    the layer about to run)``; each body issues the NEXT layer's gather
-    before the current layer's compute (the two are dataflow-independent
-    inside one loop iteration), so at most two layers' full weights exist
-    at any instant. The final iteration re-gathers the last layer
-    (clamped index) to keep the body uniform — one redundant collective
-    per step, never a shape change.
+    Since round 11 this is a thin wrapper assembling the fsdp
+    contribution (:class:`parallel.schedule.FsdpSchedule`: fwd carry
+    holds the NEXT layer's gathered weights, bwd carry the PREVIOUS
+    layer's, per-iteration grad scatters into the sharded stacked
+    layout) onto the ONE shared custom-vjp skeleton
+    (``parallel.schedule.decomposed_scan`` — carry next-layer state,
+    recompute blocks from saved boundary activations, drain grads per
+    iteration). Same signature, same numerics as the r8 original.
 
-    Backward (the custom-vjp rule — NOT autodiff through the forward
-    scan, which would stack every iteration's gathered weights into an
-    O(L) unsharded residual): a reverse scan whose carry pipelines the
-    re-gather of layer k−1's weights under layer k's backward compute,
-    recomputes the block forward from the saved layer-boundary
-    activation (so the only O(L) residual is activations — the
-    remat-scan profile; intra-block residuals are recomputed per layer,
-    which also means ``--fsdp_overlap`` implicitly carries block-level
-    remat), and scatters layer k's weight grads into the sharded stacked
-    layout every iteration — the per-layer reduce-scatter drain, issued while
-    the next (earlier) layer's backward still has compute in flight.
-
-    ``extras`` carries every traced auxiliary input the block consumes
-    (attention mask, dropout rng): custom_vjp forbids closing over
-    tracers, so they ride as explicit primal args with symbolic-zero
-    cotangents.
+    ``tp_specs`` (fsdp×tp composition) carries the Megatron model-axis
+    placement of the stacked leaves through the gather/scatter region
+    specs: the data-axis collectives then leave the model sharding
+    intact while the block's ring ppermutes (over ``model``) pipeline
+    independently of them.
     """
-    validate_overlap_mesh(mesh)
-    leaves = jax.tree.leaves(stacked)
-    if not leaves:
-        raise ValueError("overlap_scan: empty stacked parameter tree")
-    num_layers = int(leaves[0].shape[0])
-    gather, scatter = make_layer_gather(mesh, stacked, num_layers)
-    ks = jnp.arange(num_layers, dtype=jnp.int32)
+    from .schedule import (
+        FsdpSchedule, decomposed_scan, num_stacked_layers,
+    )
 
-    @jax.custom_vjp
-    def run(stacked, x, extras):
-        w0 = gather(stacked, jnp.asarray(0, jnp.int32))
-
-        def body(carry, k):
-            y, w = carry
-            # prefetch FIRST: independent of this layer's compute by
-            # construction, visible as such in the lowered while body
-            w_next = gather(stacked, jnp.minimum(k + 1, num_layers - 1))
-            y = apply_fn(w, y, k, extras)
-            return (y, w_next), None
-
-        (y, _), _ = lax.scan(body, (x, w0), ks)
-        return y
-
-    def run_fwd(stacked, x, extras):
-        w0 = gather(stacked, jnp.asarray(0, jnp.int32))
-
-        def body(carry, k):
-            y, w = carry
-            w_next = gather(stacked, jnp.minimum(k + 1, num_layers - 1))
-            y_out = apply_fn(w, y, k, extras)
-            # collect each layer's INPUT activation: the boundary
-            # residual the backward recomputes from
-            return (y_out, w_next), y
-
-        (y, _), xs = lax.scan(body, (x, w0), ks)
-        return y, (stacked, xs, extras)
-
-    def run_bwd(res, gy):
-        stacked, xs, extras = res
-        w_last = gather(stacked, jnp.asarray(num_layers - 1, jnp.int32))
-        gacc = jax.tree.map(jnp.zeros_like, stacked)
-
-        def body(carry, inputs):
-            gy, w, gacc = carry
-            k, x_k = inputs
-            # prefetch the PREVIOUS layer's weights under this layer's
-            # backward compute — the mirror of the forward pipeline
-            w_prev = gather(stacked, jnp.maximum(k - 1, 0))
-            _, pullback = jax.vjp(
-                lambda w_, x_: apply_fn(w_, x_, k, extras), w, x_k)
-            gw, gx = pullback(gy)
-            # per-layer drain: the cross-replica reduction GSPMD emits to
-            # replicate gw, then the owner-shard write — layer k's grads
-            # reach the sharded stacked layout while layer k−1's backward
-            # still has compute in flight
-            gacc = jax.tree.map(jnp.add, gacc, scatter(gw, k))
-            return (gx, w_prev, gacc), None
-
-        (gx, _, gacc), _ = lax.scan(
-            body, (gy, w_last, gacc), (ks, xs), reverse=True)
-        return gacc, gx, _zero_cotangent(extras)
-
-    run.defvjp(run_fwd, run_bwd)
-    return run(stacked, x, extras)
+    num_layers = num_stacked_layers(stacked, "overlap_scan")
+    schedule = FsdpSchedule(mesh, stacked, num_layers, tp_specs=tp_specs)
+    return decomposed_scan(schedule, apply_fn, stacked, x, extras)
 
 
 # -- HLO schedule evidence -------------------------------------------------

@@ -1,0 +1,611 @@
+"""Unified decomposed-scan: ONE custom-vjp skeleton, per-axis collective
+schedules (``--fsdp_overlap`` × ``--ddp_overlap`` × ``--tp_overlap``).
+
+r8–r10 grew three explicit-overlap execution paths — decomposed FSDP
+(``parallel/overlap.py``), compressed backward-overlapped DDP
+(``parallel/compress.py``), ring collective-matmul TP
+(``parallel/collective_matmul.py``) — that shared one skeleton by copy:
+a forward ``lax.scan`` carrying next-layer state, a hand-written
+``custom_vjp`` reverse scan recomputing each block from its saved
+boundary activation, and a per-iteration gradient drain. Each path
+refused the others, so a real pod shape (data × fsdp × model running
+simultaneously) fell back to the unoverlapped GSPMD schedule on every
+axis but one.
+
+This module is that skeleton, written exactly once
+(:func:`decomposed_scan`), with the per-mesh-axis work factored into
+*collective schedule* contributions:
+
+- **fsdp** (:class:`FsdpSchedule`): layer k+1's weight gather issued
+  before layer k's compute, layer k's grad scatter drained under layer
+  k−1's backward — the r8 pipeline, now able to gather over ``data``
+  while leaving a live ``model`` sharding on the weights intact (the
+  gather/scatter region specs carry the TP placement, so fsdp×tp
+  composes: the data-axis gathers and the model-axis ring ppermutes are
+  collectives over *different* mesh axes and pipeline independently).
+- **ddp** (:class:`DdpSchedule`): each layer's cross-replica grad reduce
+  issued inside its own reverse-scan iteration, in ``grad_comm`` wire
+  precision with the r9 quantization/error-feedback path. Composed with
+  tp, the whole block runs inside ONE ``shard_map`` region over
+  ``data × model`` using the local ring kernels
+  (``collective_matmul.tp_column_dense_local``/``tp_row_dense_local``),
+  and the drain merges TP's per-layer ``data``-psum of weight grads with
+  the compressed reduce: one exchange per layer, never a trailing wall.
+- **tp** (:class:`PlainSchedule` + the ring ops inside the block): the
+  rotation state lives inside the block's collective matmuls; the
+  framework contributes the per-layer backward structure (recompute from
+  boundary activations → every layer's weight-grad psum over ``data``
+  drains inside its own iteration via shard_map's transpose).
+
+``overlap_scan`` and ``ddp_overlap_scan`` remain as the single-axis
+entry points (same signatures, same numerics) but are now thin wrappers
+assembling a schedule and calling :func:`decomposed_scan` — no second or
+third copy of the carry/recompute/drain logic survives.
+
+Numerics: identical math to the single-axis paths (bit-exact gathers,
+ring-reassociated TP sums at the last f32 ulp); dropout streams fold the
+layer index (and under ddp the data/model shard coordinates) rather than
+``nn.scan``'s split — statistically equivalent, not bit-interchangeable
+(documented in README).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..runtime.context import DATA_AXIS, MODEL_AXIS
+
+#: module paths inside one encoder block -> logical axis names, mirroring
+#: the ``nn.with_logical_partitioning`` annotations in
+#: ``models/transformer.py``. Needed because the decomposed paths run at
+#: apply time, where params arrive as plain arrays (the boxes that carry
+#: logical names exist only at init) — the region specs must be rebuilt
+#: statically. A cross-check test pins this table against the init-time
+#: metadata so the two cannot drift silently.
+_BLOCK_LOGICAL_AXES: dict[tuple[str, str], tuple[str, ...]] = {
+    ("query", "kernel"): ("embed", "heads", "kv"),
+    ("key", "kernel"): ("embed", "heads", "kv"),
+    ("value", "kernel"): ("embed", "heads", "kv"),
+    ("query", "bias"): ("heads", "kv"),
+    ("key", "bias"): ("heads", "kv"),
+    ("value", "bias"): ("heads", "kv"),
+    ("out", "kernel"): ("heads", "kv", "embed"),
+    ("out", "bias"): ("embed",),
+    ("fc1", "kernel"): ("embed", "mlp"),
+    ("fc1", "bias"): ("mlp",),
+    ("fc2", "kernel"): ("mlp", "embed"),
+    ("fc2", "bias"): ("embed",),
+    # LayerNorms are unannotated in the model (plain nn.LayerNorm):
+    # one replicated feature dim ("embed" maps to no mesh axis)
+    ("ln_attn", "scale"): ("embed",),
+    ("ln_attn", "bias"): ("embed",),
+    ("ln_mlp", "scale"): ("embed",),
+    ("ln_mlp", "bias"): ("embed",),
+}
+
+
+def _path_keys(path) -> tuple[str, ...]:
+    return tuple(
+        getattr(p, "key", getattr(p, "name", str(p))) for p in path
+    )
+
+
+def stacked_tp_specs(stacked: Any, mesh: Mesh, *,
+                     leading_layer_dim: bool = True) -> Any:
+    """Per-leaf :class:`PartitionSpec` tree for a (stacked) encoder-block
+    param tree under the Megatron TP layout (``parallel/sharding.py``
+    rules applied to the block's logical axes).
+
+    ``leading_layer_dim``: leaves carry the stacked ``(num_layers, ...)``
+    dim first (replicated — FSDP adds its ``data`` split on top of these
+    specs via :func:`overlap.make_layer_gather`). Unknown leaf paths fail
+    with intent: a new block param silently mapped to "replicated" would
+    be silently unsharded by the region specs.
+    """
+    from .sharding import active_rules
+
+    rules = dict(active_rules(mesh))
+    flat, treedef = jax.tree_util.tree_flatten_with_path(stacked)
+    specs = []
+    for path, leaf in flat:
+        keys = _path_keys(path)
+        axes = _BLOCK_LOGICAL_AXES.get(keys[-2:]) if len(keys) >= 2 else None
+        if axes is None:
+            raise ValueError(
+                f"stacked_tp_specs: unknown block param at path "
+                f"{'/'.join(keys)} — extend _BLOCK_LOGICAL_AXES "
+                "(parallel/schedule.py) with its logical axes so the "
+                "decomposed schedules know its TP placement"
+            )
+        entries = tuple(rules.get(name) for name in axes)
+        want_ndim = len(axes) + (1 if leading_layer_dim else 0)
+        if leaf.ndim != want_ndim:
+            raise ValueError(
+                f"stacked_tp_specs: param {'/'.join(keys)} has ndim "
+                f"{leaf.ndim}, expected {want_ndim} for logical axes "
+                f"{axes} (leading_layer_dim={leading_layer_dim})"
+            )
+        specs.append(P(None, *entries) if leading_layer_dim else P(*entries))
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def spec_mentions(spec: P | None, axis: str) -> bool:
+    """True when ``axis`` appears anywhere in a PartitionSpec."""
+    for entry in tuple(spec or ()):
+        if entry is None:
+            continue
+        names = (entry,) if isinstance(entry, str) else tuple(entry)
+        if axis in names:
+            return True
+    return False
+
+
+# -- unified mesh validation -----------------------------------------------
+
+def validate_schedule_mesh(mesh: Mesh | None, *, fsdp: bool = False,
+                           ddp: bool = False, tp: bool = False) -> Mesh:
+    """Refuse meshes the composed decomposed-scan cannot serve, with the
+    reason named per axis.
+
+    The composable set is ``data`` (fsdp gathers / ddp reduces) ×
+    ``model`` (tp rings). ``seq``/``pipe``/``expert`` axes need in-region
+    handling no schedule implements; a live ``model`` axis WITHOUT a tp
+    schedule means the weights are model-sharded but the fsdp/ddp region
+    specs would silently unshard them.
+    """
+    if mesh is None:
+        raise ValueError(
+            "the decomposed overlap schedules need the device mesh "
+            "threaded into the model (models/registry.py does this; pass "
+            "mesh= when building directly)"
+        )
+    allowed = {DATA_AXIS} | ({MODEL_AXIS} if tp else set())
+    extra = {name: size for name, size in mesh.shape.items()
+             if name not in allowed and size > 1}
+    if extra:
+        if MODEL_AXIS in extra and (fsdp or ddp) and not tp:
+            what = ("--fsdp_overlap supports data-axis FSDP only"
+                    if fsdp else
+                    "--ddp_overlap supports replicated-param "
+                    "data-parallel meshes only")
+            raise ValueError(
+                f"{what} unless composed with --tp_overlap; mesh also "
+                f"has {extra}: a live '{MODEL_AXIS}' axis means the "
+                "weights are model-sharded and the "
+                f"{'gather' if fsdp else 'reduce'} region specs would "
+                "silently unshard them — pass --tp_overlap too or drop "
+                f"the {MODEL_AXIS} axis"
+            )
+        raise ValueError(
+            f"the decomposed overlap schedules compose over data×model "
+            f"only; mesh also has {extra} — drop the extra axes or the "
+            "overlap flags"
+        )
+    if tp and mesh.shape.get(MODEL_AXIS, 1) <= 1:
+        raise ValueError(
+            "--tp_overlap decomposes the tensor-parallel collectives of "
+            f"a '{MODEL_AXIS}' mesh axis, but the mesh is "
+            f"{dict(mesh.shape)} (data-only / model:1) — there is no TP "
+            "matmul to overlap; add model:N to --mesh or drop --tp_overlap"
+        )
+    return mesh
+
+
+# -- the shared custom-vjp skeleton ----------------------------------------
+
+def _slice_layer(stacked: Any, k: jax.Array) -> Any:
+    """Layer ``k`` of a stacked ``(num_layers, ...)`` tree."""
+    return jax.tree.map(
+        lambda a: lax.dynamic_index_in_dim(a, k, 0, keepdims=False), stacked)
+
+
+def num_stacked_layers(stacked: Any, what: str) -> int:
+    leaves = jax.tree.leaves(stacked)
+    if not leaves:
+        raise ValueError(f"{what}: empty stacked parameter tree")
+    return int(leaves[0].shape[0])
+
+
+def decomposed_scan(schedule: Any,
+                    apply_fn: Callable[[Any, jax.Array, jax.Array, Any],
+                                       jax.Array],
+                    stacked: Any, x: jax.Array, extras: Any, *,
+                    residual: Any | None = None,
+                    comm_rng: jax.Array | None = None) -> jax.Array:
+    """Drive ``apply_fn(layer_params, y, k, extras)`` over the stacked
+    layers under ``schedule`` — THE shared custom-vjp skeleton every
+    decomposed execution path rides (``--fsdp_overlap``,
+    ``--ddp_overlap``, ``--tp_overlap`` and their compositions).
+
+    Forward: one ``lax.scan`` whose carry holds ``(activations,
+    schedule-owned weight state)``; the schedule's :meth:`fwd_weights`
+    runs *before* the layer's compute, so anything it issues (the fsdp
+    layer-(k+1) gather) is dataflow-independent of this iteration's dots.
+    ``run_fwd`` additionally saves each layer's INPUT activation — the
+    only O(L) residual.
+
+    Backward (the custom-vjp rule — never autodiff through the forward
+    scan, which would stack every iteration's gathered state into an
+    O(L) residual): a reverse scan that recomputes each block from its
+    saved boundary activation (implicit block remat — ``--remat``
+    composes free), lets the schedule prefetch the next (earlier)
+    layer's weight state under this layer's backward compute, and drains
+    this layer's weight grads *inside the iteration* — scatter into the
+    sharded stacked layout (fsdp), compressed cross-replica reduce
+    (ddp), or the plain per-layer slot write whose ``data``-psum of TP
+    weight grads shard_map's transpose emits per layer (tp).
+
+    ``extras`` carries every traced auxiliary input the block consumes
+    (attention mask, dropout rng): custom_vjp forbids closing over
+    tracers, so they ride as explicit primal args with symbolic-zero
+    cotangents. ``residual``/``comm_rng`` thread the r9 error-feedback
+    state: the updated residual leaves the backward through the residual
+    input's cotangent slot (the only in-jit channel for
+    backward-produced state).
+    """
+    num_layers = num_stacked_layers(stacked, "decomposed_scan")
+    ks = jnp.arange(num_layers, dtype=jnp.int32)
+
+    @jax.custom_vjp
+    def run(stacked, x, extras, residual, comm_rng):
+        wc0 = schedule.fwd_init(stacked)
+
+        def body(carry, k):
+            y, wc = carry
+            # schedule state FIRST: anything issued here (the fsdp
+            # prefetch gather) is independent of this layer's compute by
+            # construction, visible as such in the lowered loop body
+            w, wc = schedule.fwd_weights(stacked, wc, k)
+            y = schedule.fwd_apply(apply_fn, w, y, k, extras)
+            return (y, wc), None
+
+        (y, _), _ = lax.scan(body, (x, wc0), ks)
+        return y
+
+    def run_fwd(stacked, x, extras, residual, comm_rng):
+        wc0 = schedule.fwd_init(stacked)
+
+        def body(carry, k):
+            y, wc = carry
+            w, wc = schedule.fwd_weights(stacked, wc, k)
+            y_out = schedule.fwd_apply(apply_fn, w, y, k, extras)
+            # save each layer's INPUT activation: the boundary residual
+            # the backward recomputes from
+            return (y_out, wc), y
+
+        (y, _), xs = lax.scan(body, (x, wc0), ks)
+        return y, (stacked, xs, extras, residual, comm_rng)
+
+    def run_bwd(res, gy):
+        stacked, xs, extras, residual, comm_rng = res
+        wc0 = schedule.bwd_init(stacked)
+        gacc0 = schedule.gacc_init(stacked)
+
+        def body(carry, inputs):
+            gy, wc, gacc = carry
+            k, x_k, res_k = inputs
+            key_k = (None if comm_rng is None
+                     else jax.random.fold_in(comm_rng, k))
+            gy, wc, gacc, ys = schedule.bwd_step(
+                apply_fn, stacked, wc, gacc, k, x_k, gy, extras,
+                res_k, key_k)
+            return (gy, wc, gacc), ys
+
+        (gx, _, gacc), ys = lax.scan(
+            body, (gy, wc0, gacc0), (ks, xs, residual), reverse=True)
+        grads, res_ct = schedule.finalize(gacc, ys)
+        if residual is None:
+            res_ct = None
+        key_ct = (None if comm_rng is None
+                  else np.zeros(np.shape(comm_rng), jax.dtypes.float0))
+        from .overlap import _zero_cotangent
+
+        return grads, gx, _zero_cotangent(extras), res_ct, key_ct
+
+    run.defvjp(run_fwd, run_bwd)
+    return run(stacked, x, extras, residual, comm_rng)
+
+
+# -- per-axis schedule contributions ---------------------------------------
+
+class PlainSchedule:
+    """Null weight schedule (``--tp_overlap`` alone): slice layer ``k``
+    from the (replicated-over-data, possibly model-sharded) stacked tree;
+    apply at the GSPMD level (the block's ring collective matmuls carry
+    their own shard_map regions); grads stack per layer out of the
+    reverse scan — each layer's TP weight-grad psum over ``data`` (the
+    shard_map transpose of the ring ops' kernel specs) drains inside its
+    own iteration instead of a post-backward wall."""
+
+    def fwd_init(self, stacked):
+        return ()
+
+    def fwd_weights(self, stacked, wc, k):
+        return _slice_layer(stacked, k), ()
+
+    def fwd_apply(self, apply_fn, w, y, k, extras):
+        return apply_fn(w, y, k, extras)
+
+    def bwd_init(self, stacked):
+        return ()
+
+    def gacc_init(self, stacked):
+        return ()
+
+    def bwd_step(self, apply_fn, stacked, wc, gacc, k, x_k, gy, extras,
+                 res_k, key_k):
+        w = _slice_layer(stacked, k)
+        _, pull = jax.vjp(
+            lambda w_, y_: apply_fn(w_, y_, k, extras), w, x_k)
+        gw, gx = pull(gy)
+        return gx, (), (), (gw, None)
+
+    def finalize(self, gacc, ys):
+        gws, _ = ys
+        return gws, None
+
+
+class FsdpSchedule:
+    """Decomposed-FSDP contribution (the r8 pipeline): the fwd carry
+    holds the NEXT layer's gathered weights, the bwd carry the PREVIOUS
+    layer's; each bwd iteration scatters its layer's grads straight into
+    the sharded stacked layout. ``tp_specs`` (fsdp×tp) threads the
+    Megatron model-axis placement through the gather/scatter region
+    specs, so the data-axis collectives leave the model sharding intact
+    and the block's ring ppermutes pipeline independently of them."""
+
+    def __init__(self, mesh: Mesh, stacked: Any, num_layers: int,
+                 tp_specs: Any | None = None):
+        from .overlap import make_layer_gather
+
+        validate_schedule_mesh(mesh, fsdp=True, tp=tp_specs is not None)
+        self.num_layers = num_layers
+        self.gather, self.scatter = make_layer_gather(
+            mesh, stacked, num_layers, tp_specs=tp_specs)
+
+    def fwd_init(self, stacked):
+        return self.gather(stacked, jnp.asarray(0, jnp.int32))
+
+    def fwd_weights(self, stacked, wc, k):
+        # prefetch FIRST: independent of this layer's compute
+        w_next = self.gather(
+            stacked, jnp.minimum(k + 1, self.num_layers - 1))
+        return wc, w_next
+
+    def fwd_apply(self, apply_fn, w, y, k, extras):
+        return apply_fn(w, y, k, extras)
+
+    def bwd_init(self, stacked):
+        return self.gather(stacked, jnp.asarray(self.num_layers - 1,
+                                                jnp.int32))
+
+    def gacc_init(self, stacked):
+        return jax.tree.map(jnp.zeros_like, stacked)
+
+    def bwd_step(self, apply_fn, stacked, wc, gacc, k, x_k, gy, extras,
+                 res_k, key_k):
+        # prefetch the PREVIOUS layer's weights under this layer's
+        # backward compute — the mirror of the forward pipeline
+        w_prev = self.gather(stacked, jnp.maximum(k - 1, 0))
+        _, pull = jax.vjp(
+            lambda w_, y_: apply_fn(w_, y_, k, extras), wc, x_k)
+        gw, gx = pull(gy)
+        # per-layer drain: the cross-replica reduction GSPMD emits to
+        # satisfy the scatter region's data-replicated in-spec, then the
+        # owner-shard write — layer k's grads reach the sharded stacked
+        # layout while layer k−1's backward still has compute in flight
+        gacc = jax.tree.map(jnp.add, gacc, self.scatter(gw, k))
+        return gx, w_prev, gacc, None
+
+    def finalize(self, gacc, ys):
+        return gacc, None
+
+
+class DdpSchedule:
+    """Compressed-DDP contribution (the r9 path): the whole per-layer
+    block vjp runs inside a ``shard_map`` region — over ``data`` alone
+    (replicated params), or over ``data × model`` when composed with tp
+    (``tp_specs`` set): the block then uses the LOCAL ring kernels and
+    the drain merges TP's per-layer ``data``-psum of weight grads with
+    the compressed reduce into one exchange. Leaves replicated over
+    ``model`` (LayerNorms, row biases) hold per-seq-chunk partials and
+    are psum'd over ``model`` before the data-axis reduce."""
+
+    def __init__(self, mesh: Mesh, stacked: Any, num_layers: int,
+                 extras_specs: Any, *, grad_comm: str = "fp32",
+                 chunk: int | None = None, tp_specs: Any | None = None,
+                 residual: Any | None = None,
+                 comm_rng: jax.Array | None = None):
+        from .compress import CHUNK, GRAD_COMM_MODES
+
+        tp = tp_specs is not None
+        validate_schedule_mesh(mesh, ddp=True, tp=tp)
+        if grad_comm not in GRAD_COMM_MODES:
+            raise ValueError(f"unknown grad_comm mode {grad_comm!r}; "
+                             f"expected one of {GRAD_COMM_MODES}")
+        if grad_comm != "fp32" and comm_rng is None:
+            raise ValueError(f"grad_comm={grad_comm!r} needs comm_rng for "
+                             "stochastic rounding")
+        if residual is not None and grad_comm == "fp32":
+            raise ValueError("error-feedback residual with grad_comm=fp32 "
+                             "is a no-op by construction; drop one of the "
+                             "two")
+        if residual is not None and tp:
+            raise ValueError(
+                "--grad_error_feedback does not compose with --tp_overlap "
+                "yet: the residual leaves are sized for replicated full-"
+                "width grads, but the TP drain reduces model-sharded "
+                "slices; drop one of the two"
+            )
+        self.mesh = mesh
+        self.n = mesh.shape.get(DATA_AXIS, 1)
+        self.grad_comm = grad_comm
+        self.chunk = chunk if chunk is not None else CHUNK
+        self.extras_specs = extras_specs
+        self.tp = tp
+        if tp:
+            self.layer_specs = jax.tree.map(
+                lambda s: P(*tuple(s)[1:]), tp_specs,
+                is_leaf=lambda s: isinstance(s, P))
+            self.x_spec = P(DATA_AXIS, MODEL_AXIS, None)
+        else:
+            self.layer_specs = jax.tree.map(
+                lambda _: P(), _slice_layer(stacked, jnp.asarray(0)))
+            self.x_spec = P(DATA_AXIS)
+        res_slice = (None if residual is None
+                     else _slice_layer(residual, jnp.asarray(0)))
+        self.res_specs = jax.tree.map(lambda _: P(DATA_AXIS), res_slice)
+        self.has_key = comm_rng is not None
+
+    def _region(self, fn, in_specs, out_specs):
+        from .shard_map_compat import shard_map
+
+        return shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+
+    def fwd_init(self, stacked):
+        return ()
+
+    def fwd_weights(self, stacked, wc, k):
+        return _slice_layer(stacked, k), ()
+
+    def fwd_apply(self, apply_fn, w, y, k, extras):
+        region = self._region(
+            lambda w_, y_, k_, e: apply_fn(w_, y_, k_, e),
+            (self.layer_specs, self.x_spec, P(), self.extras_specs),
+            self.x_spec)
+        return region(w, y, k, extras)
+
+    def bwd_init(self, stacked):
+        return ()
+
+    def gacc_init(self, stacked):
+        return ()
+
+    def bwd_step(self, apply_fn, stacked, wc, gacc, k, x_k, gy, extras,
+                 res_k, key_k):
+        from .compress import _reduce_tree
+
+        def region_body(w, x_k, gy, k, e, res_k, key):
+            # the whole per-layer vjp runs on the local shard(s): every
+            # op is per-example (and, under tp, ring-local), so these
+            # are the true per-replica partials a compressed reduce must
+            # start from
+            _, pull = jax.vjp(
+                lambda w_, y_: apply_fn(w_, y_, k, e), w, x_k)
+            gw, gx = pull(gy)
+            if self.tp:
+                # model-replicated leaves (LayerNorms, row biases) hold
+                # per-seq-chunk partials — complete them over `model`
+                # first; model-sharded kernels are already complete per
+                # shard. Then ONE data-axis exchange drains both TP's
+                # weight-grad psum and the DDP bucket reduce.
+                gw = jax.tree.map(
+                    lambda g, spec: (g if spec_mentions(spec, MODEL_AXIS)
+                                     else lax.psum(g, MODEL_AXIS)),
+                    gw, self.layer_specs,
+                )
+            gw_sum, res_new = _reduce_tree(
+                gw, res_k, key, self.grad_comm, DATA_AXIS, self.n,
+                self.chunk)
+            return gw_sum, gx, res_new
+
+        region = self._region(
+            region_body,
+            (self.layer_specs, self.x_spec, self.x_spec, P(),
+             self.extras_specs, self.res_specs,
+             P() if self.has_key else None),
+            (self.layer_specs, self.x_spec, self.res_specs))
+        gw_sum, gx, res_new = region(
+            _slice_layer(stacked, k), x_k, gy, k, extras, res_k, key_k)
+        # per-layer drain: gw_sum is fully reduced HERE, inside the
+        # iteration — independent of every earlier layer's backward
+        return gx, (), (), (gw_sum, res_new)
+
+    def finalize(self, gacc, ys):
+        gws, res = ys
+        return gws, res
+
+
+# -- composed-schedule HLO evidence ----------------------------------------
+
+def hlo_composed_evidence(hlo_text: str) -> dict[str, Any]:
+    """Witness that a composed (fsdp×tp) lowering carries BOTH axes'
+    collectives compute-independent in ONE scanned body.
+
+    Two operand walks over the same HLO
+    (``overlap.hlo_overlap_evidence``): the *gather family* (all-reduce/
+    all-gather/reduce-scatter/all-to-all — the data-axis fsdp/ddp
+    collectives) and the *ring family* (collective-permute — the
+    model-axis TP hops). The TP rings lower to nested loop computations
+    called FROM the layer-scan body, so "one scanned body" means: a
+    dot-carrying loop body whose gather collectives are compute-
+    independent AND that either contains independent ppermutes directly
+    or calls a nested ring body all of whose ppermutes are independent.
+    ``composed_overlap_independent`` is the headline boolean.
+    """
+    import re
+
+    from .overlap import hlo_overlap_evidence
+
+    gather_ev = hlo_overlap_evidence(
+        hlo_text, collectives=("all-reduce", "all-gather",
+                               "reduce-scatter", "all-to-all"))
+    ring_ev = hlo_overlap_evidence(hlo_text,
+                                   collectives=("collective-permute",))
+
+    def norm(name: str) -> str:
+        return name.lstrip("%")
+
+    gather_ind = {norm(r["computation"]) for r in gather_ev["bodies"]
+                  if r["compute_independent_collectives"] > 0}
+    ring_ind = {norm(r["computation"]) for r in ring_ev["bodies"]
+                if r["compute_independent_collectives"] > 0
+                and r["compute_dependent_collectives"] == 0}
+
+    # map each computation to the computations it references (while
+    # bodies, calls, fusions) so a gather body "contains" the ring
+    # bodies its nested loops execute
+    refs: dict[str, set[str]] = {}
+    cur: str | None = None
+    ref_re = re.compile(
+        r"(?:body|condition|to_apply|calls|branch_computations)="
+        r"[{(]?%?([\w.\-]+)")
+    for line in hlo_text.splitlines():
+        stripped = line.strip()
+        if stripped.endswith("{") and "(" in stripped and "->" in stripped:
+            cur = norm(stripped.split(" ", 1)[0])
+            refs[cur] = set()
+            continue
+        if stripped.startswith("}"):
+            cur = None
+            continue
+        if cur is not None:
+            refs[cur].update(ref_re.findall(stripped))
+
+    def reaches_ring(name: str, seen: set[str]) -> bool:
+        if name in ring_ind:
+            return True
+        if name in seen:
+            return False
+        seen.add(name)
+        return any(reaches_ring(r, seen) for r in refs.get(name, ()))
+
+    both = sorted(
+        b for b in gather_ind
+        if b in ring_ind or reaches_ring(b, set())
+    )
+    return {
+        "gather_bodies": gather_ev["bodies"],
+        "ring_bodies": ring_ev["bodies"],
+        "independent_gather_bodies": len(gather_ind),
+        "independent_ring_bodies": len(ring_ind),
+        "bodies_with_both_independent": both,
+        "composed_overlap_independent": len(both) >= 1,
+    }

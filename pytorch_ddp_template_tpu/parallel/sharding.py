@@ -301,6 +301,34 @@ def describe(mesh: Mesh, config: Any = None,
                     (comp + rest_bytes) / 1e6, 3)
                 out["grad_wire_mb_fp32"] = round(
                     (base + rest_bytes) / 1e6, 3)
+        # unified overlap summary (r11): one coherent block for a composed
+        # run instead of three disjoint per-axis fragments. The legacy
+        # per-axis keys above (fsdp_mode / ddp_mode / tp_mode /
+        # grad_wire_* / tp_wire_*) remain as aliases — the bench-record
+        # contract tests read them — and the block adds the combined
+        # explicit-collective wire total.
+        modes = {}
+        if "fsdp_mode" in out:
+            modes["fsdp"] = out["fsdp_mode"]
+        if "ddp_mode" in out:
+            modes["ddp"] = out["ddp_mode"]
+        if "tp_mode" in out:
+            modes["tp"] = out["tp_mode"]
+        if modes:
+            decomposed = [k for k, v in modes.items()
+                          if v not in (None, "gspmd-default", "zero1")]
+            wire_parts = {}
+            if "grad_wire_mb_per_step" in out:
+                wire_parts["grad_mb"] = out["grad_wire_mb_per_step"]
+            if "tp_wire_mb_per_step" in out:
+                wire_parts["tp_mb"] = out["tp_wire_mb_per_step"]
+            out["overlap"] = {
+                "schedule": modes,
+                "decomposed_axes": decomposed,
+                "composed": len(decomposed) >= 2,
+                **wire_parts,
+                "wire_mb_per_step": round(sum(wire_parts.values()), 3),
+            }
         if getattr(config, "fsdp", False) and params is not None:
             # read the PLACED shardings, not a re-derivation: under TP some
             # dims already carry the model axis and the chooser would lie

@@ -109,6 +109,10 @@ class TestKitchenSink:
         assert sum('"step": 4,' in line for line in evals) == 1, evals
         assert sum('"step": 8,' in line for line in evals) == 1, evals
 
+    @pytest.mark.slow  # ~20s two-run CLI composition — moved to the slow
+    #                    set in r11 to keep the grown tier-1 suite inside
+    #                    the 870s budget (the r8–r10 convention; the full
+    #                    `pytest tests/` run still covers it)
     def test_pipeline_flags_compose(self, tmp_path):
         """gpt-pipe-tiny + accumulation + eval + resume on a data x pipe
         mesh through the real CLI: the round-5 pipeline entry composes

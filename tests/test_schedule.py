@@ -1,0 +1,454 @@
+"""Unified decomposed-scan framework (parallel/schedule.py): the composed
+fsdp×tp and ddp×tp execution paths must be numerically interchangeable
+with the FLOPs-matched GSPMD default on the same ``data×model`` mesh
+(loss + every grad leaf, rtol per the r10 ring-reassociation convention),
+the static TP-spec table must agree with the init-time flax metadata,
+the combinations that remain unsupported must refuse with named reasons
+at the earliest level (config parse > registry build > mesh validation),
+and the composed lowering must show BOTH axes' collectives compute-
+independent in one scanned body (slow leg)."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from pytorch_ddp_template_tpu.config import TrainingConfig, parse_args
+from pytorch_ddp_template_tpu.models import build
+from pytorch_ddp_template_tpu.parallel.overlap import overlap_scan
+from pytorch_ddp_template_tpu.parallel.schedule import (
+    PlainSchedule,
+    decomposed_scan,
+    hlo_composed_evidence,
+    stacked_tp_specs,
+    validate_schedule_mesh,
+)
+from pytorch_ddp_template_tpu.parallel.sharding import (
+    active_rules, fsdp_reshard,
+)
+from pytorch_ddp_template_tpu.runtime import make_mesh
+
+#: the r10 convention: column ops bit-exact, row ops / ring head / gather
+#: psums reassociate cross-device sums at the last f32 ulp; 1e-5 is pure
+#: headroom (observed composed-vs-default grad gap ~3e-8)
+TOL = 1e-5
+
+
+def _max_abs_diff(a, b):
+    return max(
+        float(jnp.max(jnp.abs(x.astype(jnp.float32) - y.astype(jnp.float32))))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+def _mesh42():
+    return make_mesh("data:4,model:2")
+
+
+# -- toy-level skeleton units ----------------------------------------------
+
+class TestDecomposedScanToy:
+    def _ref(self, tree, x, L):
+        y = x
+        for k in range(L):
+            h = jnp.tanh(y @ tree["w1"][k] + tree["b1"][k])
+            y = y + h @ tree["w2"][k] + tree["b2"][k]
+        return (y ** 2).sum()
+
+    def _host_tree(self, L, E, F):
+        rng = np.random.default_rng(0)
+        return {
+            "w1": (rng.standard_normal((L, E, F)) * 0.2).astype(np.float32),
+            "b1": (rng.standard_normal((L, F)) * 0.1).astype(np.float32),
+            "w2": (rng.standard_normal((L, F, E)) * 0.2).astype(np.float32),
+            "b2": (rng.standard_normal((L, E)) * 0.1).astype(np.float32),
+        }
+
+    def test_plain_schedule_matches_reference(self, devices):
+        """The null weight schedule (tp-only shape): slice + GSPMD apply
+        + per-layer grad stacking, values and grads vs straight-line."""
+        L, E, F = 3, 4, 6
+        host = self._host_tree(L, E, F)
+        tree = jax.tree.map(jnp.asarray, host)
+        x = jnp.asarray(np.random.default_rng(1).standard_normal((2, E)),
+                        jnp.float32)
+
+        def apply_one(w, y, k, extras):
+            return y + jnp.tanh(y @ w["w1"] + w["b1"]) @ w["w2"] + w["b2"]
+
+        def loss(tree, x):
+            return (decomposed_scan(PlainSchedule(), apply_one, tree, x,
+                                    ()) ** 2).sum()
+
+        l, (g, gx) = jax.jit(
+            jax.value_and_grad(loss, argnums=(0, 1)))(tree, x)
+        lr, (gr, gxr) = jax.jit(jax.value_and_grad(
+            lambda t, x: self._ref(t, x, L), argnums=(0, 1)))(tree, x)
+        np.testing.assert_allclose(float(l), float(lr), rtol=1e-6)
+        assert _max_abs_diff(g, gr) < 1e-5
+        assert _max_abs_diff(gx, gxr) < 1e-5
+
+    def test_fsdp_gather_with_tp_specs_matches_reference(self, devices):
+        """fsdp×tp at the op level: stacked weights split over ``data``
+        on the layer dim AND ``model`` on their Megatron dims; the gather
+        pipeline (overlap_scan with tp_specs) leaves the model sharding
+        intact while the block's ring matmuls rotate over ``model``."""
+        from pytorch_ddp_template_tpu.parallel.collective_matmul import (
+            tp_column_dense, tp_row_dense,
+        )
+
+        mesh = _mesh42()
+        L, B, T, E, F = 4, 8, 16, 8, 16
+        host = self._host_tree(L, E, F)
+        tp_specs = {"w1": P(None, None, "model"), "b1": P(None, "model"),
+                    "w2": P(None, "model", None), "b2": P(None, None)}
+        placed = {
+            "w1": P("data", None, "model"), "b1": P("data", "model"),
+            "w2": P("data", "model", None), "b2": P("data", None),
+        }
+        stacked = {k: jax.device_put(jnp.asarray(v),
+                                     NamedSharding(mesh, placed[k]))
+                   for k, v in host.items()}
+        x = jnp.asarray(np.random.default_rng(2).standard_normal((B, T, E)),
+                        jnp.float32)
+
+        def apply_one(w, y, k, extras):
+            (h,) = tp_column_dense(y, [w["w1"]], [w["b1"]], mesh)
+            return y + tp_row_dense(jnp.tanh(h), w["w2"], w["b2"], mesh)
+
+        def loss(stacked, x):
+            return (overlap_scan(apply_one, stacked, x, (), mesh,
+                                 tp_specs=tp_specs) ** 2).sum()
+
+        l, (g, gx) = jax.jit(
+            jax.value_and_grad(loss, argnums=(0, 1)))(stacked, x)
+
+        def ref(tree, x):
+            y = x
+            for k in range(L):
+                h = jnp.tanh(y @ tree["w1"][k] + tree["b1"][k])
+                y = y + h @ tree["w2"][k] + tree["b2"][k]
+            return (y ** 2).sum()
+
+        lr, (gr, gxr) = jax.jit(jax.value_and_grad(
+            ref, argnums=(0, 1)))(jax.tree.map(jnp.asarray, host), x)
+        np.testing.assert_allclose(float(l), float(lr), rtol=1e-5)
+        for k in host:
+            np.testing.assert_allclose(np.asarray(g[k]), np.asarray(gr[k]),
+                                       rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(gx), np.asarray(gxr),
+                                   rtol=1e-4, atol=1e-4)
+        # the gather left the model placement intact: grads land in the
+        # stacked layout with BOTH axes still on their dims
+        assert "data" in str(g["w1"].sharding.spec)
+        assert "model" in str(g["w1"].sharding.spec)
+
+
+# -- the static spec table vs init-time flax metadata ----------------------
+
+def test_stacked_tp_specs_match_init_metadata(devices):
+    """The apply-time spec table (_BLOCK_LOGICAL_AXES) must agree
+    leaf-for-leaf with what flax's logical annotations resolve to at init
+    — the two sources cannot be allowed to drift."""
+    mesh = _mesh42()
+    cfg = TrainingConfig(model="gpt-tiny", dataset_size=32,
+                         scan_layers=True, tp_overlap=True)
+    task, ds = build("gpt-tiny", cfg, mesh=mesh)
+    batch = {k: jnp.asarray(np.asarray(v))
+             for k, v in ds.batch(np.arange(8)).items()}
+    boxed, _ = task.init(jax.random.PRNGKey(0), batch)
+
+    def find_layers(tree):
+        if isinstance(tree, dict):
+            for key, sub in tree.items():
+                if key == "layers":
+                    return sub
+                found = find_layers(sub)
+                if found is not None:
+                    return found
+        return None
+
+    layers_boxed = find_layers(boxed)
+    assert layers_boxed is not None
+    meta_shardings = nn.logical_to_mesh_sharding(
+        nn.get_partition_spec(layers_boxed), mesh, active_rules(mesh))
+    derived = stacked_tp_specs(nn.meta.unbox(layers_boxed), mesh)
+
+    flat_meta = jax.tree_util.tree_flatten_with_path(meta_shardings)[0]
+    flat_derived = jax.tree_util.tree_flatten_with_path(
+        derived, is_leaf=lambda v: isinstance(v, P))[0]
+    assert len(flat_meta) == len(flat_derived) > 0
+    for (path_m, sharding), (path_d, spec) in zip(flat_meta, flat_derived):
+        assert path_m == path_d
+        meta_spec = tuple(getattr(sharding, "spec", sharding))
+        pad = max(len(meta_spec), len(tuple(spec)))
+        norm = lambda s: tuple(s) + (None,) * (pad - len(tuple(s)))
+        assert norm(meta_spec) == norm(spec), (path_m, meta_spec, spec)
+
+
+# -- model-level composed parity (the tier-1 tripwire) ---------------------
+
+def test_composed_loss_and_grad_parity(devices):
+    """fsdp×tp AND ddp×tp vs the FLOPs-matched GSPMD default on a
+    data:4,model:2 mesh: loss and every grad leaf within the r10 rtol
+    convention. One default task serves both comparisons (eval-mode loss
+    is placement-independent; the composed paths get the params in their
+    own layouts)."""
+    mesh = _mesh42()
+
+    def mk(**kw):
+        cfg = TrainingConfig(model="gpt-tiny", dataset_size=32,
+                             scan_layers=True, **kw)
+        return build("gpt-tiny", cfg, mesh=mesh)
+
+    task_default, ds = mk(fused_head=True)
+    task_ft, _ = mk(fsdp_overlap=True, tp_overlap=True)
+    task_dt, _ = mk(ddp_overlap=True, tp_overlap=True)
+    assert task_ft.model.fsdp_overlap and task_ft.model.tp_overlap
+    assert task_dt.model.ddp_overlap and task_dt.model.tp_overlap
+    batch = {k: jax.device_put(np.asarray(v),
+                               NamedSharding(mesh, P("data")))
+             for k, v in ds.batch(np.arange(8)).items()}
+    params, _ = task_default.init(jax.random.PRNGKey(0), batch)
+    params = nn.meta.unbox(params)
+
+    def loss_of(task):
+        def f(p):
+            loss, _, _ = task.loss(p, {}, batch, None, train=False)
+            return loss
+        return jax.jit(jax.value_and_grad(f))
+
+    ld, gd = loss_of(task_default)(params)
+
+    # ddp×tp: replicated (model-sharded) params, region over data×model
+    ldt, gdt = loss_of(task_dt)(params)
+    np.testing.assert_allclose(float(ld), float(ldt), atol=TOL)
+    assert _max_abs_diff(gd, gdt) < TOL
+
+    # fsdp×tp: the SAME params in the fsdp×tp layout (layer/within-layer
+    # data split on top of the model split — gpt-tiny's 2 layers on
+    # data:4 exercise the within-layer fallback with masked tp dims)
+    pf = fsdp_reshard(params, mesh, prefer_dim=0)
+    lft, gft = loss_of(task_ft)(pf)
+    np.testing.assert_allclose(float(ld), float(lft), atol=TOL)
+    assert _max_abs_diff(gd, gft) < TOL
+
+
+# -- describe(): one coherent overlap block ---------------------------------
+
+def test_describe_unified_overlap_block(devices):
+    """A composed run must report ONE coherent schedule summary (axes,
+    composed flag, combined wire total) instead of three disjoint
+    fragments; the legacy per-axis keys stay as aliases for the
+    bench-record contract tests."""
+    from pytorch_ddp_template_tpu.parallel.sharding import describe
+
+    mesh = _mesh42()
+    cfg = TrainingConfig(model="gpt-tiny", scan_layers=True,
+                         ddp_overlap=True, tp_overlap=True,
+                         grad_comm="int8")
+    task, _ = build("gpt-tiny", cfg, mesh=mesh)
+    d = describe(mesh, cfg, model=task.model)
+    block = d["overlap"]
+    assert block["schedule"] == {"ddp": "per-layer-overlapped-reduce",
+                                 "tp": "ring-decomposed"}
+    assert sorted(block["decomposed_axes"]) == ["ddp", "tp"]
+    assert block["composed"] is True
+    # combined wire total covers every component present
+    assert block["wire_mb_per_step"] == pytest.approx(
+        block.get("tp_mb", 0) + block.get("grad_mb", 0))
+    assert block["tp_mb"] == d["tp_wire_mb_per_step"]  # alias agreement
+    # legacy keys still present (aliases)
+    assert d["tp_mode"] == "ring-decomposed"
+    assert d["ddp_mode"] == "per-layer-overlapped-reduce"
+    assert d["grad_comm"] == "int8"
+
+    # single-axis run: block present, composed False
+    cfg1 = TrainingConfig(model="gpt-tiny", scan_layers=True,
+                          fsdp_overlap=True)
+    d1 = describe(make_mesh("data:-1"), cfg1)
+    assert d1["overlap"]["schedule"] == {"fsdp": "decomposed-prefetch"}
+    assert d1["overlap"]["composed"] is False
+
+    # gspmd-default everywhere: no decomposed axes
+    d2 = describe(mesh, TrainingConfig(model="gpt-tiny", fsdp=True))
+    assert d2["overlap"]["decomposed_axes"] == []
+
+
+# -- refusals with intent ---------------------------------------------------
+
+class TestRefusals:
+    def test_mesh_level_named_reasons(self, devices):
+        # fsdp with a live model axis and no tp schedule
+        with pytest.raises(ValueError, match="data-axis FSDP only"):
+            validate_schedule_mesh(_mesh42(), fsdp=True)
+        # ddp with a live model axis and no tp schedule
+        with pytest.raises(ValueError, match="data-parallel meshes only"):
+            validate_schedule_mesh(_mesh42(), ddp=True)
+        # tp without a model axis
+        with pytest.raises(ValueError, match="no TP matmul to overlap"):
+            validate_schedule_mesh(make_mesh("data:-1"), ddp=True, tp=True)
+        # axes outside data×model
+        with pytest.raises(ValueError, match="seq"):
+            validate_schedule_mesh(make_mesh("data:2,model:2,seq:2"),
+                                   fsdp=True, tp=True)
+        with pytest.raises(ValueError, match="mesh"):
+            validate_schedule_mesh(None, fsdp=True)
+
+    def test_parse_time_mesh_consistency(self):
+        base = ["--model", "gpt-tiny", "--scan_layers"]
+        # tp without a live model axis in --mesh: named at parse time,
+        # not deep inside shard_map spec construction
+        with pytest.raises(ValueError, match="no live model axis"):
+            parse_args(base + ["--tp_overlap"])
+        with pytest.raises(ValueError, match="no live model axis"):
+            parse_args(base + ["--tp_overlap", "--mesh", "data:4,model:1"])
+        # ddp/fsdp with a live model axis and no TP schedule
+        with pytest.raises(ValueError, match="pass --tp_overlap too"):
+            parse_args(base + ["--ddp_overlap", "--mesh", "data:4,model:2"])
+        with pytest.raises(ValueError, match="pass --tp_overlap too"):
+            parse_args(base + ["--fsdp_overlap", "--mesh",
+                               "data:4,model:2"])
+        # axes outside data×model
+        with pytest.raises(ValueError, match="live axes"):
+            parse_args(base + ["--tp_overlap", "--fsdp_overlap", "--mesh",
+                               "data:2,model:2,seq:2"])
+        # the consistent composed spellings parse
+        cfg = parse_args(base + ["--tp_overlap", "--fsdp_overlap",
+                                 "--mesh", "data:4,model:2"])
+        assert cfg.fsdp and cfg.fsdp_overlap and cfg.tp_overlap
+        cfg = parse_args(base + ["--tp_overlap", "--ddp_overlap",
+                                 "--mesh", "data:4,model:2",
+                                 "--grad_comm", "int8"])
+        assert cfg.ddp_overlap and cfg.tp_overlap
+        # wildcard model counts as live
+        cfg = parse_args(base + ["--tp_overlap", "--mesh",
+                                 "data:4,model:-1"])
+        assert cfg.tp_overlap
+
+    def test_registry_level(self, devices):
+        mesh = _mesh42()
+        # MoE: refused for every composed spelling
+        with pytest.raises(ValueError, match="MoE"):
+            build("gpt-moe-tiny",
+                  TrainingConfig(model="gpt-moe-tiny", scan_layers=True,
+                                 fsdp_overlap=True, tp_overlap=True),
+                  mesh=mesh)
+        with pytest.raises(ValueError, match="MoE"):
+            build("gpt-moe-tiny",
+                  TrainingConfig(model="gpt-moe-tiny", scan_layers=True,
+                                 ddp_overlap=True, tp_overlap=True),
+                  mesh=mesh)
+        # pipe: the co-required --scan_layers gate names the conflict
+        with pytest.raises(ValueError, match="GPipe pipeline|stage"):
+            build("gpt-pipe-tiny",
+                  TrainingConfig(model="gpt-pipe-tiny", scan_layers=True,
+                                 fsdp_overlap=True, tp_overlap=True),
+                  mesh=mesh)
+        # fsdp×ddp stays impossible (params cannot be both sharded and
+        # replicated) — named at config level
+        with pytest.raises(ValueError, match="pick one execution mode"):
+            TrainingConfig(model="gpt-tiny", scan_layers=True,
+                           fsdp_overlap=True, ddp_overlap=True,
+                           tp_overlap=True)
+
+
+# -- engine-level composed steps (slow: train-step compiles) ----------------
+
+@pytest.mark.slow
+@pytest.mark.parametrize("compose", ["fsdp_tp", "ddp_tp"])
+def test_engine_step_parity_composed(compose, devices):
+    """One full jitted optimizer step per composed mode vs its
+    FLOPs-matched GSPMD default: every weight within TOL. Dropout cloned
+    OFF (the composed paths fold layer/shard indices where nn.scan
+    splits — statistically equivalent, not the math this pins)."""
+    from pytorch_ddp_template_tpu.parallel.sharding import shard_tree
+    from pytorch_ddp_template_tpu.train.engine import (
+        TrainState, make_optimizer, make_train_step,
+    )
+
+    mesh = _mesh42()
+
+    def mk(**kw):
+        cfg = TrainingConfig(model="gpt-tiny", dataset_size=32,
+                             scan_layers=True, **kw)
+        task, ds = build("gpt-tiny", cfg, mesh=mesh)
+        task.model = task.model.clone(dropout_rate=0.0)
+        return task, ds
+
+    if compose == "fsdp_tp":
+        task_d, ds = mk(fused_head=True, fsdp=True)
+        task_c, _ = mk(fsdp_overlap=True, tp_overlap=True)
+        reshard = True
+    else:
+        task_d, ds = mk(fused_head=True)
+        task_c, _ = mk(ddp_overlap=True, tp_overlap=True)
+        reshard = False
+    batch = {k: jax.device_put(np.asarray(v),
+                               NamedSharding(mesh, P("data")))
+             for k, v in ds.batch(np.arange(8)).items()}
+    cfg = TrainingConfig(model="gpt-tiny", warmup_steps=0)
+    key = jax.random.PRNGKey(0)
+    states, metrics = {}, {}
+    for tag, task in (("default", task_d), ("composed", task_c)):
+        params, extra = task.init(key, batch)
+        tx, schedule = make_optimizer(cfg, total_steps=10)
+        state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                           extra_vars=extra, opt_state=tx.init(params),
+                           rng=jax.random.clone(key))
+        state = shard_tree(state, mesh)
+        if reshard:
+            state = state.replace(
+                params=fsdp_reshard(state.params, mesh, prefer_dim=0),
+                opt_state=fsdp_reshard(state.opt_state, mesh,
+                                       prefer_dim=0))
+        step = make_train_step(task, tx, schedule)
+        states[tag], metrics[tag] = step(state, batch)
+    np.testing.assert_allclose(np.asarray(metrics["default"]["loss"]),
+                               np.asarray(metrics["composed"]["loss"]),
+                               atol=TOL)
+    assert _max_abs_diff(states["default"].params,
+                         states["composed"].params) < TOL
+
+
+@pytest.mark.slow
+def test_hlo_composed_evidence(devices):
+    """Depth-4 fsdp×tp compiled train step: ≥1 dot-carrying scanned body
+    must show compute-independent gather-family collectives AND reach
+    compute-independent ring ppermutes (directly or via its nested ring
+    loops) — the composed-schedule witness."""
+    from pytorch_ddp_template_tpu.models.gpt import CausalLmTask, GptDecoder
+    from pytorch_ddp_template_tpu.parallel.sharding import shard_tree
+    from pytorch_ddp_template_tpu.train.engine import (
+        TrainState, make_optimizer, make_train_step,
+    )
+
+    mesh = _mesh42()
+    vocab, seq, depth = 128, 32, 4
+    ids = np.random.default_rng(0).integers(0, vocab, (8, seq))
+    batch = {"input_ids": jax.device_put(
+        np.asarray(ids, np.int32), NamedSharding(mesh, P("data")))}
+    model = GptDecoder(vocab_size=vocab, max_len=seq, num_layers=depth,
+                       num_heads=2, head_dim=16, mlp_dim=64,
+                       scan_layers=True, fsdp_overlap=True,
+                       tp_overlap=True, fused_head=True, mesh=mesh)
+    task = CausalLmTask(model)
+    params, extra = task.init(jax.random.PRNGKey(0), batch)
+    tx, schedule = make_optimizer(
+        TrainingConfig(warmup_steps=0), total_steps=10)
+    state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                       extra_vars=extra, opt_state=tx.init(params),
+                       rng=jax.random.PRNGKey(0))
+    state = shard_tree(state, mesh)
+    state = state.replace(
+        params=fsdp_reshard(state.params, mesh, prefer_dim=0),
+        opt_state=fsdp_reshard(state.opt_state, mesh, prefer_dim=0))
+    compiled = make_train_step(task, tx, schedule).lower(
+        state, batch).compile()
+    ev = hlo_composed_evidence(compiled.as_text())
+    assert ev["independent_gather_bodies"] > 0, ev
+    assert ev["independent_ring_bodies"] > 0, ev
+    assert ev["composed_overlap_independent"], ev

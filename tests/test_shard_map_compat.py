@@ -1,0 +1,116 @@
+"""First direct unit tests for ``parallel/shard_map_compat.py`` — the
+jax-version seam EVERY decomposed schedule rides through (fsdp gathers,
+ddp reduce regions, TP rings, and since r11 the composed fsdp×tp/ddp×tp
+paths). The wrapper must (a) resolve to a real shard_map on this jaxlib,
+(b) map the modern ``check_vma`` kwarg onto whatever spelling the
+installed jax accepts, and (c) behave identically to the plain function
+on replicated specs, on live axes, and on degenerate size-1 axes."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from pytorch_ddp_template_tpu.parallel import shard_map_compat
+from pytorch_ddp_template_tpu.parallel.shard_map_compat import shard_map
+from pytorch_ddp_template_tpu.runtime import make_mesh
+
+
+class TestKwargMapping:
+    def test_wrapper_found_a_real_shard_map(self):
+        assert callable(shard_map_compat._shard_map)
+
+    def test_installed_jax_has_a_known_replication_check_spelling(self):
+        """The kwarg-introspection set must contain the core signature and
+        (on every jax this repo supports) one of the two replication-check
+        spellings — if BOTH vanish the wrapper silently stops disabling
+        the check, which the seam's callers rely on for custom collectives."""
+        params = shard_map_compat._PARAMS
+        assert {"mesh", "in_specs", "out_specs"} <= params
+        assert ("check_vma" in params) or ("check_rep" in params), params
+
+    @pytest.mark.parametrize("check_vma", [None, False, True])
+    def test_check_vma_values_all_construct_and_run(self, devices, check_vma):
+        mesh = make_mesh("data:-1")
+        out = shard_map(lambda x: x * 2, mesh=mesh, in_specs=P(),
+                        out_specs=P(), check_vma=check_vma)(jnp.ones(()))
+        assert float(out) == 2.0
+
+
+class TestPassthrough:
+    def test_replicated_specs_match_plain_function(self, devices):
+        mesh = make_mesh("data:-1")
+        x = jnp.asarray(np.random.default_rng(0).standard_normal((4, 4)),
+                        jnp.float32)
+        fn = lambda a: jnp.tanh(a) + 1.0
+        out = shard_map(fn, mesh=mesh, in_specs=P(), out_specs=P(),
+                        check_vma=False)(x)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(fn(x)))
+
+    def test_sharded_identity_round_trips(self, devices):
+        mesh = make_mesh("data:-1")
+        n = mesh.shape["data"]
+        x = jnp.arange(4 * n, dtype=jnp.float32).reshape(n, 4)
+        out = shard_map(lambda a: a, mesh=mesh, in_specs=P("data"),
+                        out_specs=P("data"), check_vma=False)(x)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+    def test_region_sees_the_local_shard_shape(self, devices):
+        mesh = make_mesh("data:-1")
+        n = mesh.shape["data"]
+        x = jnp.zeros((2 * n, 3))
+
+        def body(a):
+            assert a.shape == (2, 3)  # trace-time: per-shard view
+            return a
+
+        shard_map(body, mesh=mesh, in_specs=P("data"),
+                  out_specs=P("data"), check_vma=False)(x)
+
+
+class TestLiveVsDegenerateAxes:
+    @pytest.mark.parametrize("spec,axis", [("data:-1", "data"),
+                                           ("data:8,model:1", "model")])
+    def test_psum_sums_live_and_passes_through_size1(self, devices, spec,
+                                                     axis):
+        """A psum over an 8-way live axis multiplies by 8; over a size-1
+        axis it is the identity — the degenerate-mesh behaviour every
+        schedule's collectives depend on (single-chip runs must not
+        change values)."""
+        mesh = make_mesh(spec)
+        n = mesh.shape[axis]
+        out = shard_map(lambda x: jax.lax.psum(x, axis), mesh=mesh,
+                        in_specs=P(), out_specs=P(), check_vma=False)(
+            jnp.asarray(3.0))
+        assert float(out) == pytest.approx(3.0 * n)
+
+    def test_axis_index_enumerates_live_axis(self, devices):
+        mesh = make_mesh("data:-1")
+        n = mesh.shape["data"]
+        out = shard_map(
+            lambda: jax.lax.axis_index("data")[None], mesh=mesh,
+            in_specs=(), out_specs=P("data"), check_vma=False)()
+        np.testing.assert_array_equal(np.asarray(out), np.arange(n))
+
+
+class TestTranspose:
+    def test_grad_of_replicated_input_sums_over_unmentioned_axis(self,
+                                                                 devices):
+        """shard_map's transpose SUMS a cotangent over every mesh axis
+        its input spec does not mention — the mechanism the TP ops use to
+        get their per-layer weight-grad psum over ``data`` for free, and
+        since r11 the drain the composed schedules merge into. Pin it at
+        the seam: d/dw of sum(w * x_sharded) must be the GLOBAL sum of x."""
+        mesh = make_mesh("data:-1")
+        n = mesh.shape["data"]
+        x = jnp.arange(2 * n, dtype=jnp.float32).reshape(n, 2)
+
+        def f(w, x):
+            region = shard_map(lambda w_, x_: w_ * x_, mesh=mesh,
+                               in_specs=(P(), P("data")),
+                               out_specs=P("data"), check_vma=False)
+            return region(w, x).sum()
+
+        gw = jax.jit(jax.grad(f))(jnp.asarray(1.0), x)
+        assert float(gw) == pytest.approx(float(x.sum()))
