@@ -153,6 +153,38 @@ class TrainingConfig:
     #                              scalar from the step N-K dispatch each
     #                              iteration, capping host-side buffer growth
     #                              and carrying the device-side stop agreement
+    health_pack: bool = True  # in-step device-side health scalars
+    #                           (obs/health.py): param norm, update ratio,
+    #                           non-finite counts, per-layer grad norms
+    #                           under --scan_layers, EF-residual norm —
+    #                           computed inside the jitted step, drained
+    #                           through the async telemetry channel
+    #                           (zero extra host syncs; overhead measured
+    #                           by BENCH_MODE=obs). --no_health_pack for
+    #                           the before-leg / minimal-metrics runs
+    anomaly: str = "off"  # off | warn | halt — anomaly sentry
+    #                       (obs/sentry.py): rolling median/MAD spike
+    #                       detection on loss/grad_norm + a non-finite
+    #                       trigger over the per-step health feed; on
+    #                       trigger, dump a flight-record triage bundle
+    #                       to <output_dir>/flight_records/. `halt` also
+    #                       stops the run through the same device-side
+    #                       stop agreement SIGTERM uses (checkpoint +
+    #                       clean exit on every host coherently)
+    anomaly_window: int = 128  # ring-buffer steps the sentry keeps (and
+    #                            the rolling median/MAD history length)
+    anomaly_threshold: float = 10.0  # spike trigger at
+    #                                  |x - median| > threshold * scale,
+    #                                  scale = max(1.4826*MAD, 5%|median|)
+    hlo_report: bool = False  # compile the train step ahead of the loop
+    #                           and write an HLO schedule report
+    #                           (obs/hlo_report.py) to
+    #                           <output_dir>/hlo_report.json: collective
+    #                           census + wire bytes, overlap-evidence
+    #                           walkers, and WARNs when an overlap flag's
+    #                           collectives are not compute-independent
+    #                           (the schedule-regression tripwire). Costs
+    #                           one extra ahead-of-time compilation
 
     def __post_init__(self) -> None:
         # --fsdp_overlap is an execution strategy FOR the FSDP layout: the
@@ -210,6 +242,17 @@ class TrainingConfig:
                 "yet: the residual leaves are sized for replicated "
                 "full-width grads, but the ddp×tp drain reduces "
                 "model-sharded slices; drop one of the two"
+            )
+        if self.anomaly not in ("off", "warn", "halt"):
+            raise ValueError(
+                f"unknown --anomaly {self.anomaly!r}; expected "
+                "off | warn | halt"
+            )
+        if self.anomaly != "off" and not self.health_pack:
+            raise ValueError(
+                "--anomaly needs the in-step health pack (its non-finite "
+                "counters are the sentry's hard trigger); drop "
+                "--no_health_pack or set --anomaly off"
             )
         if self.grad_error_feedback and self.gradient_accumulation_steps > 1:
             raise ValueError(
@@ -516,6 +559,46 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "land up to one interval late, step keys exact); "
                         "'sync' converts inline (pre-async behaviour, the "
                         "host_overhead_pct before-leg in BENCH_MODE=e2e).")
+    p.add_argument("--no_health_pack", dest="health_pack",
+                   action="store_false",
+                   help="Disable the in-step health scalars (param norm, "
+                        "update ratio ‖Δw‖/‖w‖, non-finite counts, "
+                        "per-layer grad norms under --scan_layers, "
+                        "EF-residual norm). On by default: the bundle is "
+                        "a few fused device reductions riding the async "
+                        "telemetry channel — BENCH_MODE=obs pins the "
+                        "overhead inside the 0.9 neutrality band.")
+    p.add_argument("--anomaly", type=str, default="off",
+                   choices=["off", "warn", "halt"],
+                   help="Anomaly sentry over the per-step health feed: "
+                        "rolling median/MAD spike detection on "
+                        "loss/grad_norm plus a non-finite trigger. On "
+                        "trigger, a triage bundle (ring-buffer JSONL, "
+                        "describe() snapshot, config, divergence "
+                        "fingerprint, and a short profiler trace of the "
+                        "following steps) lands in "
+                        "<output_dir>/flight_records/. 'halt' then stops "
+                        "the run through the same device-side stop "
+                        "agreement SIGTERM uses — every host checkpoints "
+                        "and exits at the same step.")
+    p.add_argument("--anomaly_window", type=int, default=128,
+                   help="Sentry ring-buffer length in steps (also the "
+                        "rolling median/MAD history).")
+    p.add_argument("--anomaly_threshold", type=float, default=10.0,
+                   help="Spike sensitivity in robust deviations: trigger "
+                        "at |x - median| > threshold * max(1.4826*MAD, "
+                        "5%% of |median|).")
+    p.add_argument("--hlo_report", action="store_true",
+                   help="Compile the train step ahead of the loop and "
+                        "write obs/hlo_report.py's schedule report to "
+                        "<output_dir>/hlo_report.json (collective census "
+                        "+ estimated wire bytes + the r8-r11 overlap-"
+                        "evidence walkers), WARNing when an active "
+                        "overlap flag's collectives are not compute-"
+                        "independent in the compiled program — the "
+                        "schedule-regression tripwire, in production "
+                        "rather than only in bench. Costs one extra "
+                        "ahead-of-time compilation at startup.")
     p.add_argument("--max_inflight_steps", type=int, default=2,
                    help="Bounded dispatch depth K: each iteration the loop "
                         "reads one scalar produced K steps ago (complete in "

@@ -1,0 +1,56 @@
+"""Observability subsystem (round 12): the training loop watching itself.
+
+Three coordinated pieces (ISSUE 7):
+
+- :mod:`.health` — in-step device-side health scalars (param/update
+  norms, non-finite counts, per-layer grad norms, EF-residual norm)
+  riding the r6 async-telemetry channel with zero extra host syncs;
+- :mod:`.sentry` — host-side ring buffer + median/MAD anomaly detection
+  (``--anomaly {off,warn,halt}``) and the flight-recorder triage bundle
+  under ``<output_dir>/flight_records/``;
+- :mod:`.hlo_report` — the r8-r11 HLO overlap-evidence walkers factored
+  out of bench-only code, plus the ``--hlo_report`` startup schedule
+  report and its overlap-regression tripwire.
+
+Import discipline: :mod:`.hlo_report` is pure stdlib and must STAY
+reachable without jax installed/imported (the ``parallel/`` delegates and
+any text-only consumer pull it), so this ``__init__`` is lazy (PEP 562):
+importing ``pytorch_ddp_template_tpu.obs.hlo_report`` executes only this
+docstring, never :mod:`.health`'s jax/optax imports. :mod:`.health`
+imports ``parallel.stacking`` lazily inside the function for the same
+no-cycle reason.
+"""
+
+from typing import Any
+
+_EXPORTS = {
+    "health": ("HEALTH_KEYS", "health_metrics"),
+    "hlo_report": (
+        "GATHER_FAMILY",
+        "RING_FAMILY",
+        "check_overlap_expectations",
+        "collective_evidence",
+        "composed_evidence",
+        "op_census",
+        "ring_evidence",
+        "schedule_report",
+    ),
+    "sentry": (
+        "BUNDLE_FILES",
+        "FLIGHT_TRACE_STEPS",
+        "SPIKE_KEYS",
+        "AnomalySentry",
+        "FlightRecorder",
+    ),
+}
+
+__all__ = [name for names in _EXPORTS.values() for name in names]
+
+
+def __getattr__(name: str) -> Any:  # PEP 562 lazy re-export
+    for module, names in _EXPORTS.items():
+        if name in names:
+            from importlib import import_module
+
+            return getattr(import_module(f".{module}", __name__), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,111 @@
+"""In-step health pack: device-side training-health scalars, zero extra
+host syncs.
+
+The jitted train step already produces ``loss``/``grad_norm``/``lr``; this
+module adds the rest of the per-iteration health bundle the large-scale
+stacks log every step (Megatron-LM's grad-norm/num-zeros discipline,
+PyTorch DDP's detect-anomaly lineage):
+
+- ``param_norm`` — global L2 of the weights the step consumed;
+- ``update_ratio`` — ``‖Δw‖ / ‖w‖`` of the applied optimizer update (the
+  classic learning-dynamics dial: healthy runs sit around 1e-3-ish;
+  collapse and divergence both show here before the loss moves);
+- ``nonfinite_loss`` / ``nonfinite_grads`` — element counts of NaN/Inf in
+  the loss and the gradient tree (the sentry's hard trigger);
+- ``per_layer_grad_norm`` — an ``(L,)`` vector of per-layer grad norms.
+  Cheap ONLY under ``--scan_layers``: the stacked ``(L, ...)`` grad
+  leaves reduce over their trailing dims in one fused kernel. Unrolled
+  models skip it (L separate reductions per leaf family would be real
+  work for a per-step metric);
+- ``ef_residual_norm`` — global L2 of the error-feedback residual when
+  ``--grad_error_feedback`` carries one (a growing residual means the
+  compression is no longer telescoping).
+
+Everything is a device array computed inside the jitted step — a handful
+of fused reductions next to a backward pass, invisible in step time
+(measured: ``BENCH_MODE=obs``) — and rides the r6 ``AsyncTelemetry``
+device-array channel to the host, so ``host_overhead_pct`` stays at the
+r6 level. Keys are stable: the sentry, the metrics writer and the bench
+leg all consume :data:`HEALTH_KEYS`.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+#: every key the pack may add to the step metrics (per_layer_grad_norm and
+#: ef_residual_norm appear only when their structure exists)
+HEALTH_KEYS = (
+    "param_norm",
+    "update_ratio",
+    "nonfinite_loss",
+    "nonfinite_grads",
+    "per_layer_grad_norm",
+    "ef_residual_norm",
+)
+
+
+def _stacked_leaves(tree: Any) -> list[jax.Array]:
+    """Leaves living under a scan-over-layers ``"layers"`` dict key —
+    the stacked ``(num_layers, ...)`` weight/grad leaves
+    (``parallel/stacking.LAYER_AXIS`` naming, established r7)."""
+    from ..parallel.stacking import LAYER_AXIS
+
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out = []
+    for path, leaf in flat:
+        in_stack = any(
+            getattr(p, "key", getattr(p, "name", None)) == LAYER_AXIS
+            for p in path
+        )
+        if in_stack and isinstance(leaf, jax.Array) and leaf.ndim >= 1:
+            out.append(leaf)
+    return out
+
+
+def _nonfinite_count(tree: Any) -> jax.Array:
+    """Total count of non-finite elements across the tree's float leaves
+    (int leaves cannot be non-finite; skipping them avoids isfinite on
+    integer dtypes)."""
+    total = jnp.zeros((), jnp.int32)
+    for leaf in jax.tree.leaves(tree):
+        if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact):
+            total = total + jnp.sum(
+                ~jnp.isfinite(leaf), dtype=jnp.int32)
+    return total
+
+
+def health_metrics(*, loss: jax.Array, grads: Any, params: Any,
+                   updates: Any, residual: Any = None) -> dict[str, jax.Array]:
+    """The device-side health bundle (see module docstring). Call inside
+    the jitted step, after the optimizer update is computed; every value
+    is a device scalar except ``per_layer_grad_norm`` (an ``(L,)``
+    vector, present only when the grad tree carries a scanned layer
+    stack — a trace-time structural property, so jit specialises it
+    away for unrolled models)."""
+    out: dict[str, jax.Array] = {}
+    param_norm = optax.global_norm(params)
+    out["param_norm"] = param_norm
+    out["update_ratio"] = optax.global_norm(updates) / (param_norm + 1e-20)
+    out["nonfinite_loss"] = jnp.sum(
+        ~jnp.isfinite(loss), dtype=jnp.int32)
+    out["nonfinite_grads"] = _nonfinite_count(grads)
+    stacked = _stacked_leaves(grads)
+    if stacked:
+        # each (L, ...) leaf reduces over its trailing dims; summing the
+        # per-leaf squares gives the (L,) per-layer global norms in one
+        # fused pass over memory the backward just touched
+        sq = None
+        for g in stacked:
+            part = jnp.sum(
+                jnp.square(g.astype(jnp.float32)),
+                axis=tuple(range(1, g.ndim)))
+            sq = part if sq is None else sq + part
+        out["per_layer_grad_norm"] = jnp.sqrt(sq)
+    if residual is not None:
+        out["ef_residual_norm"] = optax.global_norm(residual)
+    return out

@@ -1,0 +1,422 @@
+"""HLO schedule analysis: the overlap-evidence walkers, factored out of
+bench-only code into a production subsystem.
+
+History: the operand-chain walker was born as
+``parallel/overlap.hlo_overlap_evidence`` (r8, BENCH_MODE=overlap), grew a
+ring-narrowed variant ``parallel/collective_matmul.hlo_tp_evidence`` (r10)
+and a composed two-family variant ``parallel/schedule.
+hlo_composed_evidence`` (r11) — but all three only ever ran inside bench
+legs, so a production run whose overlap schedule silently degraded to
+serial collectives (a spec change, an XLA upgrade, a flag interaction)
+had no tripwire. This module is the shared home: the ``parallel/``
+spellings remain as thin delegates (their callers and committed-record
+semantics are unchanged), and :func:`schedule_report` +
+:func:`check_overlap_expectations` put the same analysis behind
+``--hlo_report`` at engine startup.
+
+Everything here is pure text analysis over ``compiled.as_text()`` — no
+jax imports, safe to call from any thread or process.
+
+What the walker proves (and what it cannot): a *compute-independent*
+collective inside a dot-carrying loop body is the schedulability witness —
+the latency-hiding scheduler MAY start it at the top of the iteration and
+run the matmuls under it. Whether overlap then *happens* is a
+scheduler/hardware property, measured on TPU by the tools/ followup
+scripts; this analysis proves what instruction text can: the dataflow
+freedom exists (or, for the tripwire, that it does NOT).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+#: the data-axis collective family: what FSDP weight gathers, DDP grad
+#: reduces (incl. the compressed all-to-all phase) and ZeRO scatters
+#: lower to
+GATHER_FAMILY = ("all-reduce", "all-gather", "reduce-scatter", "all-to-all")
+#: the model-axis family: the ring kernels' single-hop rotations are the
+#: only collective the decomposed TP hot path issues
+RING_FAMILY = ("collective-permute",)
+
+#: itemsize of the HLO shape prefix dtypes seen on this harness (wire-byte
+#: estimates; unknown dtypes fall back to 4)
+_ITEMSIZE = {
+    "f64": 8, "s64": 8, "u64": 8, "c64": 8,
+    "f32": 4, "s32": 4, "u32": 4,
+    "bf16": 2, "f16": 2, "s16": 2, "u16": 2,
+    "s8": 1, "u8": 1, "pred": 1,
+}
+
+_SHAPE_RE = re.compile(r"([a-z]+\d*)\[([0-9,]*)\]")
+_TOKEN_RE = re.compile(r"%[\w.\-]+")
+
+
+def parse_computations(hlo_text: str) -> list[tuple[str, list[str]]]:
+    """Split an HLO module dump into ``(computation_name, instructions)``
+    pairs (instruction lines only, braces stripped)."""
+    bodies: list[tuple[str, list[str]]] = []
+    cur: list[str] | None = None
+    name = ""
+    for line in hlo_text.splitlines():
+        stripped = line.strip()
+        if stripped.endswith("{") and ("(" in stripped and "->" in stripped):
+            cur = []
+            name = stripped.split(" ", 1)[0]
+            continue
+        if stripped == "}" or stripped.startswith("}"):
+            if cur:
+                bodies.append((name, cur))
+            cur = None
+            continue
+        if cur is not None and "=" in stripped:
+            cur.append(stripped)
+    return bodies
+
+
+def collective_evidence(hlo_text: str,
+                        collectives: tuple[str, ...] | None = None,
+                        ) -> dict[str, Any]:
+    """Analyse compiled HLO for the decomposed schedule's signature.
+
+    For every non-entry computation that contains both matmuls and a
+    cross-replica collective (on this harness those are exactly the
+    layer-scan loop bodies, forward and backward), walk each collective's
+    operand chain and classify it as *compute-independent* (its inputs
+    reach only loop-carried state — the stacked params and the induction
+    variable, never a same-body dot) or *compute-dependent* (it consumes
+    this iteration's dots, e.g. the per-layer gradient reduction).
+
+    A compute-independent collective inside a dot-carrying loop body is
+    the schedulability witness: the latency-hiding scheduler may start it
+    at the top of the iteration and run the matmuls under it — the
+    layer-(k+1) weight gather issued before layer k's compute retires.
+    Dependent collectives (the backward grad drain) can only overlap
+    ACROSS iterations (start in iteration k, complete during k-1), which
+    instruction-level text cannot prove; their presence and count are
+    reported as-is.
+
+    Headline booleans: ``prefetch_gather_independent`` (≥1 loop body has
+    a compute-independent collective — the forward prefetch) and
+    ``bwd_regather_independent`` (≥2 such bodies — the backward re-gather
+    pipeline too).
+
+    ``collectives`` overrides the default op set — ``parallel/compress.py``
+    adds ``all-to-all`` (its reduce-scatter phase) when analysing the
+    compressed-DDP schedule.
+    """
+    if collectives is None:
+        collectives = ("all-reduce", "all-gather", "reduce-scatter",
+                       "collective-permute")
+
+    def is_dot(s: str) -> bool:
+        return " dot(" in s or " convolution(" in s
+
+    def is_collective(s: str) -> bool:
+        return any(f" {c}(" in s or f" {c}-start(" in s
+                   for c in collectives)
+
+    rows = []
+    for body_name, instrs in parse_computations(hlo_text):
+        if body_name.upper().startswith("ENTRY"):
+            # entry holds the pre-loop warm gather and the optimizer
+            # tail — not a layer-schedule witness either way
+            continue
+        defs: dict[str, tuple[list[str], str]] = {}
+        for s in instrs:
+            lhs, _, rhs = s.partition("=")
+            names = _TOKEN_RE.findall(lhs)
+            if not names:
+                continue
+            # operands: %refs on the RHS; refs to other computations
+            # (calls=, to_apply=) simply miss the defs map and end the walk
+            defs[names[0]] = (_TOKEN_RE.findall(rhs), s)
+        dot_names = {n for n, (_, s) in defs.items() if is_dot(s)}
+        coll_names = [n for n, (_, s) in defs.items() if is_collective(s)]
+        if not dot_names or not coll_names:
+            continue
+
+        dep_cache: dict[str, bool] = {}
+
+        def depends_on_dot(n: str) -> bool:
+            if n in dep_cache:
+                return dep_cache[n]
+            dep_cache[n] = False  # cycles impossible in HLO; guards re-entry
+            if n in dot_names:
+                dep_cache[n] = True
+                return True
+            ops = defs.get(n, ([], ""))[0]
+            dep_cache[n] = any(depends_on_dot(o) for o in ops)
+            return dep_cache[n]
+
+        independent = [n for n in coll_names
+                       if not any(depends_on_dot(o)
+                                  for o in defs[n][0])]
+        rows.append({
+            "computation": body_name,
+            "dots": len(dot_names),
+            "collectives": len(coll_names),
+            "compute_independent_collectives": len(independent),
+            "compute_dependent_collectives":
+                len(coll_names) - len(independent),
+        })
+    with_indep = [r for r in rows
+                  if r["compute_independent_collectives"] > 0]
+    return {
+        "bodies": rows,
+        "prefetch_gather_independent": len(with_indep) >= 1,
+        "bwd_regather_independent": len(with_indep) >= 2,
+    }
+
+
+def ring_evidence(hlo_text: str) -> dict[str, Any]:
+    """Ring-schedule witness for a compiled ``--tp_overlap`` program.
+
+    :func:`collective_evidence` with the collective set narrowed to
+    ``collective-permute`` (the only collective the ring kernels issue on
+    the hot path): a dot-carrying loop body whose ppermute operands reach
+    only loop-carried state is a ring step the latency-hiding scheduler
+    may run under the dots. Headline counts: ``ring_bodies`` (dot-carrying
+    bodies with any ppermute) and ``independent_ring_bodies`` (all of
+    whose ppermutes are compute-independent). Callers compare a
+    forward-only lowering against the full train step to attribute bodies
+    to fwd vs bwd (instruction text alone cannot).
+    """
+    ev = collective_evidence(hlo_text, collectives=RING_FAMILY)
+    bodies = ev["bodies"]
+    independent = [r for r in bodies
+                   if r["compute_independent_collectives"] > 0
+                   and r["compute_dependent_collectives"] == 0]
+    return {
+        "bodies": bodies,
+        "ring_bodies": len(bodies),
+        "independent_ring_bodies": len(independent),
+    }
+
+
+def composed_evidence(hlo_text: str) -> dict[str, Any]:
+    """Witness that a composed (fsdp×tp) lowering carries BOTH axes'
+    collectives compute-independent in ONE scanned body.
+
+    Two operand walks over the same HLO: the *gather family*
+    (:data:`GATHER_FAMILY` — the data-axis fsdp/ddp collectives) and the
+    *ring family* (:data:`RING_FAMILY` — the model-axis TP hops). The TP
+    rings lower to nested loop computations called FROM the layer-scan
+    body, so "one scanned body" means: a dot-carrying loop body whose
+    gather collectives are compute-independent AND that either contains
+    independent ppermutes directly or calls a nested ring body all of
+    whose ppermutes are independent. ``composed_overlap_independent`` is
+    the headline boolean.
+    """
+    gather_ev = collective_evidence(hlo_text, collectives=GATHER_FAMILY)
+    ring_ev = collective_evidence(hlo_text, collectives=RING_FAMILY)
+
+    def norm(name: str) -> str:
+        return name.lstrip("%")
+
+    gather_ind = {norm(r["computation"]) for r in gather_ev["bodies"]
+                  if r["compute_independent_collectives"] > 0}
+    ring_ind = {norm(r["computation"]) for r in ring_ev["bodies"]
+                if r["compute_independent_collectives"] > 0
+                and r["compute_dependent_collectives"] == 0}
+
+    # map each computation to the computations it references (while
+    # bodies, calls, fusions) so a gather body "contains" the ring
+    # bodies its nested loops execute
+    refs: dict[str, set[str]] = {}
+    cur: str | None = None
+    ref_re = re.compile(
+        r"(?:body|condition|to_apply|calls|branch_computations)="
+        r"[{(]?%?([\w.\-]+)")
+    for line in hlo_text.splitlines():
+        stripped = line.strip()
+        if stripped.endswith("{") and "(" in stripped and "->" in stripped:
+            cur = norm(stripped.split(" ", 1)[0])
+            refs[cur] = set()
+            continue
+        if stripped.startswith("}"):
+            cur = None
+            continue
+        if cur is not None:
+            refs[cur].update(ref_re.findall(stripped))
+
+    def reaches_ring(name: str, seen: set[str]) -> bool:
+        if name in ring_ind:
+            return True
+        if name in seen:
+            return False
+        seen.add(name)
+        return any(reaches_ring(r, seen) for r in refs.get(name, ()))
+
+    both = sorted(
+        b for b in gather_ind
+        if b in ring_ind or reaches_ring(b, set())
+    )
+    return {
+        "gather_bodies": gather_ev["bodies"],
+        "ring_bodies": ring_ev["bodies"],
+        "independent_gather_bodies": len(gather_ind),
+        "independent_ring_bodies": len(ring_ind),
+        "bodies_with_both_independent": both,
+        "composed_overlap_independent": len(both) >= 1,
+    }
+
+
+def _shape_bytes(instr: str, op: str) -> int:
+    """Estimated result bytes of a collective instruction: the last
+    ``dtype[dims]`` group BEFORE the opcode token (for the plain
+    ``%x = f32[4,8]{1,0} all-gather(...)`` form that is the result shape;
+    for ``-start`` tuple forms it is the output element of the buffer
+    pair). An estimate, not an accounting — good enough to rank what
+    dominates the wire."""
+    idx = instr.find(f" {op}")
+    head = instr[:idx] if idx >= 0 else instr
+    last = None
+    for m in _SHAPE_RE.finditer(head):
+        last = m
+    if last is None:
+        return 0
+    dtype, dims_s = last.group(1), last.group(2)
+    n = 1
+    for d in dims_s.split(","):
+        if d:
+            n *= int(d)
+    return n * _ITEMSIZE.get(dtype, 4)
+
+
+def op_census(hlo_text: str) -> dict[str, dict[str, int]]:
+    """Count every collective instruction in the module (all
+    computations, entry included) with estimated wire bytes per op kind.
+    ``-start`` and plain spellings count as one op each (``-done`` is the
+    completion marker of its ``-start``, not a second collective)."""
+    census: dict[str, dict[str, int]] = {}
+    ops = GATHER_FAMILY + RING_FAMILY
+    for _, instrs in parse_computations(hlo_text):
+        for s in instrs:
+            for op in ops:
+                if f" {op}(" in s or f" {op}-start(" in s:
+                    row = census.setdefault(op, {"count": 0, "wire_bytes": 0})
+                    row["count"] += 1
+                    row["wire_bytes"] += _shape_bytes(s, op)
+                    break
+    return census
+
+
+def schedule_report(hlo_text: str) -> dict[str, Any]:
+    """The always-on production report over one compiled train step.
+
+    One dict, JSON-ready, combining the three walkers the bench legs run
+    separately plus a module-wide collective census:
+
+    - ``ops``: per-opcode count + estimated wire bytes (module-wide);
+    - ``gather``: the data-axis family's dot-carrying-body evidence
+      (bodies, independent/dependent counts — the fsdp/ddp witness);
+    - ``ring``: the model-axis ppermute evidence (the tp witness);
+    - ``composed``: the r11 both-axes-in-one-body evidence, with the
+      SAME ``independent_gather_bodies``/``independent_ring_bodies``
+      counts the ``BENCH_MODE=overlap3d`` committed record carries.
+
+    Axis attribution is by family: under the decomposed schedules the
+    gather family rides the ``data`` axis and collective-permute the
+    ``model`` axis (GSPMD-default programs may blur this; the census
+    keeps the raw per-opcode truth either way).
+    """
+    # ONE composed walk supplies all three sections: its gather_bodies/
+    # ring_bodies ARE the per-family walks' row lists (re-running
+    # collective_evidence/ring_evidence here would parse a multi-MB HLO
+    # dump three times for identical rows)
+    composed = composed_evidence(hlo_text)
+    census = op_census(hlo_text)
+    gather_bodies = composed["gather_bodies"]
+    ring_rows = composed["ring_bodies"]
+    clean_ring = [r for r in ring_rows
+                  if r["compute_independent_collectives"] > 0
+                  and r["compute_dependent_collectives"] == 0]
+    return {
+        "ops": census,
+        "wire_mb_estimate": round(
+            sum(r["wire_bytes"] for r in census.values()) / 1e6, 3),
+        "gather": {
+            "bodies": gather_bodies,
+            "dot_carrying_bodies": len(gather_bodies),
+            "independent_bodies": sum(
+                1 for r in gather_bodies
+                if r["compute_independent_collectives"] > 0),
+            "independent_collectives": sum(
+                r["compute_independent_collectives"] for r in gather_bodies),
+            "dependent_collectives": sum(
+                r["compute_dependent_collectives"] for r in gather_bodies),
+        },
+        "ring": {
+            "bodies": ring_rows,
+            "ring_bodies": len(ring_rows),
+            "independent_ring_bodies": len(clean_ring),
+        },
+        "composed": {
+            "independent_gather_bodies":
+                composed["independent_gather_bodies"],
+            "independent_ring_bodies": composed["independent_ring_bodies"],
+            "bodies_with_both_independent":
+                composed["bodies_with_both_independent"],
+            "composed_overlap_independent":
+                composed["composed_overlap_independent"],
+        },
+    }
+
+
+def check_overlap_expectations(report: dict[str, Any], config: Any,
+                               axis_sizes: dict[str, int]) -> list[str]:
+    """The schedule-regression tripwire: WARN strings for every overlap
+    flag whose compiled program does NOT show its schedulability witness.
+
+    Each check gates on its axis actually being parallel (``axis_sizes``
+    from the live mesh): a single-replica run compiles no collectives at
+    all, which is degenerate, not degraded. The returned strings are
+    ready for ``log.warning`` — empty list means every active overlap
+    flag's collectives are compute-independent where they must be.
+    """
+    warns: list[str] = []
+    data = axis_sizes.get("data", 1)
+    model = axis_sizes.get("model", 1)
+    gather = report["gather"]
+    ring = report["ring"]
+    if getattr(config, "fsdp_overlap", False) and data > 1:
+        if gather["independent_bodies"] < 1:
+            warns.append(
+                "--fsdp_overlap is on but NO dot-carrying loop body has a "
+                "compute-independent gather-family collective: the weight "
+                "gathers cannot start under compute — the schedule has "
+                "degraded to serial gather-then-compute "
+                f"(bodies={gather['dot_carrying_bodies']}, "
+                f"dependent={gather['dependent_collectives']})"
+            )
+    if getattr(config, "ddp_overlap", False) and data > 1:
+        per_layer = sum(r["collectives"] for r in gather["bodies"])
+        if per_layer < 1:
+            warns.append(
+                "--ddp_overlap is on but no gather-family collective lives "
+                "inside any dot-carrying loop body: the per-layer grad "
+                "reduce has left the backward scan — gradients are "
+                "draining as one post-backward wall again"
+            )
+    if getattr(config, "tp_overlap", False) and model > 1:
+        if ring["independent_ring_bodies"] < 1:
+            warns.append(
+                "--tp_overlap is on but no dot-carrying loop body carries "
+                "only compute-independent collective-permutes: the ring "
+                "rotations cannot hide under the partial dots — the "
+                "collective matmuls have degraded to blocking rotations "
+                f"(ring_bodies={ring['ring_bodies']})"
+            )
+    if (getattr(config, "tp_overlap", False)
+            and (getattr(config, "fsdp_overlap", False)
+                 or getattr(config, "ddp_overlap", False))
+            and data > 1 and model > 1):
+        if not report["composed"]["composed_overlap_independent"]:
+            warns.append(
+                "composed schedule: no scanned body carries BOTH "
+                "compute-independent gather-family collectives and "
+                "independent ring ppermutes — the two axes' overlap "
+                "pipelines are no longer composed in one body"
+            )
+    return warns

@@ -1,0 +1,216 @@
+"""Anomaly sentry + flight recorder: the host side of the health pack.
+
+The sentry consumes the per-step health records the telemetry drain
+thread converts (``kind="health"`` — device arrays in, host floats out;
+see ``train/metrics.py``) and keeps the last ``window`` of them in a ring
+buffer. Two trigger classes:
+
+- **non-finite**: any of ``nonfinite_loss``/``nonfinite_grads`` > 0, or a
+  drained ``loss``/``grad_norm`` that is itself NaN/Inf — fires
+  immediately, no history needed (the r9 lineage: a NaN'd replica must
+  not keep training);
+- **spike**: rolling median/MAD on ``loss`` and ``grad_norm`` over the
+  ring — robust statistics, so the detector survives the heavy-tailed
+  step-to-step noise a mean/std z-score false-positives on. Fires when
+  ``|x - median| > threshold * scale`` with
+  ``scale = max(1.4826·MAD, 5%·|median|, 1e-6)`` (the MAD floor keeps a
+  flat-lined loss from alarming on micro-wiggle), after ``min_history``
+  finite samples exist.
+
+Threading contract: ``observe`` runs on the telemetry drain thread;
+``poll_trigger`` on the train loop. The handoff is one attribute write
+guarded by a lock; the loop polls once per iteration (an attribute read —
+nothing on the hot path).
+
+The :class:`FlightRecorder` writes the triage bundle — the data you wish
+you had AFTER a run died — into ``<output_dir>/flight_records/``:
+ring-buffer JSONL (the last K steps of health scalars), the sharding/
+schedule ``describe()`` snapshot, the full config, the replicated-state
+divergence fingerprint, and the trigger record itself. The engine then
+arms a ``TraceWindow`` over the next few steps into the same directory,
+so the profile of the sick step pattern rides along. All JSON goes
+through ``utils.serialization.json_sanitize`` — the bundle's whole point
+is non-finite values, and it must stay parseable anyway.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import statistics
+import threading
+import time
+from collections import deque
+from pathlib import Path
+from typing import Any
+
+from ..utils.logging import get_logger
+from ..utils.serialization import json_sanitize
+
+log = get_logger(__name__)
+
+#: steps of jax-profiler trace the engine captures after a trigger (into
+#: the bundle directory) — small by design: the pattern, not a session
+FLIGHT_TRACE_STEPS = 4
+
+#: every file a complete bundle contains (the bench obs leg and the tests
+#: assert against this list — keep it in sync with FlightRecorder.dump)
+BUNDLE_FILES = ("trigger.json", "ring.jsonl", "config.json",
+                "describe.json", "fingerprint.json")
+
+#: keys the spike detector watches (must be in the per-step health feed)
+SPIKE_KEYS = ("loss", "grad_norm")
+
+
+class AnomalySentry:
+    """Rolling-statistics anomaly detector over drained health records."""
+
+    def __init__(self, mode: str = "warn", *, window: int = 128,
+                 threshold: float = 10.0, min_history: int = 16):
+        if mode not in ("warn", "halt"):
+            raise ValueError(f"unknown anomaly mode {mode!r}; "
+                             "expected warn | halt")
+        self.mode = mode
+        self.threshold = float(threshold)
+        self.min_history = int(min_history)
+        self._ring: deque[tuple[int, dict[str, Any]]] = deque(
+            maxlen=max(int(window), 8))
+        self._lock = threading.Lock()
+        self._trigger: dict[str, Any] | None = None
+        self._delivered = False
+
+    # -- drain-thread side -------------------------------------------------
+    def observe(self, step: int, scalars: dict[str, Any]) -> None:
+        """Feed one step's host-converted health record; runs on the
+        telemetry drain thread. Never raises (a broken record must not
+        kill telemetry — it IS the failure path)."""
+        try:
+            reasons = self._detect(scalars)
+        except Exception:  # noqa: BLE001
+            log.exception("anomaly detection failed on a record")
+            reasons = []
+        first = False
+        with self._lock:
+            self._ring.append((int(step), dict(scalars)))
+            if reasons and self._trigger is None:
+                first = True
+                self._trigger = {
+                    "step": int(step),
+                    "reasons": reasons,
+                    "scalars": dict(scalars),
+                    "mode": self.mode,
+                    "time": time.time(),
+                }
+        if first:
+            # visible immediately, even before the loop polls — but only
+            # for the FIRST trigger: a permanently-NaN'd run keeps
+            # producing reasons every step, and one error line per step
+            # for the rest of a long warn-mode run is log flooding, not
+            # observability (the ring buffer still records every step)
+            log.error("anomaly sentry triggered",
+                      {"step": int(step), "reasons": reasons})
+
+    def _detect(self, scalars: dict[str, Any]) -> list[str]:
+        reasons: list[str] = []
+        for key in ("nonfinite_loss", "nonfinite_grads"):
+            v = scalars.get(key)
+            if v is not None and math.isfinite(v) and v > 0:
+                reasons.append(f"{key}={int(v)}")
+        for key in SPIKE_KEYS:
+            x = scalars.get(key)
+            if x is None:
+                continue
+            x = float(x)
+            if not math.isfinite(x):
+                reasons.append(f"{key} non-finite ({x!r})")
+                continue
+            hist = [float(r[1][key]) for r in self._ring
+                    if key in r[1] and isinstance(r[1][key], (int, float))
+                    and math.isfinite(float(r[1][key]))]
+            if len(hist) < self.min_history:
+                continue
+            med = statistics.median(hist)
+            mad = statistics.median(abs(h - med) for h in hist)
+            scale = max(1.4826 * mad, 0.05 * abs(med), 1e-6)
+            if abs(x - med) > self.threshold * scale:
+                reasons.append(
+                    f"{key} spike: {x:.6g} vs rolling median {med:.6g} "
+                    f"(mad {mad:.3g}, threshold {self.threshold:g}x)")
+        return reasons
+
+    # -- train-loop side ---------------------------------------------------
+    def poll_trigger(self) -> dict[str, Any] | None:
+        """The trigger record, exactly once (later polls return None);
+        an attribute read + lock — safe to call every iteration."""
+        if self._trigger is None or self._delivered:
+            return None
+        with self._lock:
+            if self._trigger is None or self._delivered:
+                return None
+            self._delivered = True
+            return dict(self._trigger)
+
+    @property
+    def triggered(self) -> bool:
+        return self._trigger is not None
+
+    def records(self) -> list[dict[str, Any]]:
+        """Ring-buffer snapshot, oldest first, one dict per step."""
+        with self._lock:
+            return [{"step": s, **r} for s, r in self._ring]
+
+
+class FlightRecorder:
+    """Writes triage bundles under ``<output_dir>/flight_records/``."""
+
+    def __init__(self, output_dir: str | Path):
+        self.base = Path(output_dir) / "flight_records"
+
+    def dump(self, *, step: int, trigger: dict[str, Any],
+             ring: list[dict[str, Any]],
+             config: Any = None,
+             describe_snapshot: dict[str, Any] | None = None,
+             fingerprint: list[float] | None = None) -> Path:
+        """Write one complete bundle; returns its directory. Each file is
+        written best-effort and independently — a failure in one artifact
+        (e.g. a describe() that raises on poisoned params) must not cost
+        the others."""
+        d = self.base / f"step_{step:08d}"
+        suffix = 0
+        while d.exists():  # a re-trigger at the same step never clobbers
+            suffix += 1
+            d = self.base / f"step_{step:08d}.{suffix}"
+        d.mkdir(parents=True)
+
+        def _write(name: str, payload: Any) -> None:
+            try:
+                if name.endswith(".jsonl"):
+                    text = "\n".join(
+                        json.dumps(json_sanitize(r), allow_nan=False)
+                        for r in payload) + "\n"
+                else:
+                    body = (json_sanitize(payload)
+                            if isinstance(payload, dict) else payload)
+                    text = json.dumps(body, indent=2, default=str,
+                                      allow_nan=False)
+                (d / name).write_text(text)
+            except Exception:  # noqa: BLE001 - partial bundle > no bundle
+                log.exception(f"flight record artifact {name} failed")
+
+        _write("trigger.json", trigger)
+        _write("ring.jsonl", ring)
+        if config is not None and hasattr(config, "to_json"):
+            try:
+                (d / "config.json").write_text(config.to_json())
+            except Exception:  # noqa: BLE001
+                log.exception("flight record artifact config.json failed")
+        else:
+            _write("config.json", config)
+        _write("describe.json", describe_snapshot)
+        _write("fingerprint.json",
+               {"fingerprint": fingerprint,
+                "note": "per-leaf (sum, l2) digest of the replicated "
+                        "params (utils/divergence.fingerprint); null when "
+                        "the state was not safely readable at dump time"})
+        log.warning("flight record dumped", {"dir": str(d)})
+        return d

@@ -476,26 +476,17 @@ def tp_wire_bytes_per_step(*, batch: int, seq: int, embed: int,
 def hlo_tp_evidence(hlo_text: str) -> dict[str, Any]:
     """Ring-schedule witness for a compiled ``--tp_overlap`` program.
 
-    Reuses ``parallel/overlap.hlo_overlap_evidence``'s loop-body operand
-    walk with the collective set narrowed to ``collective-permute`` (the
-    only collective the ring kernels issue on the hot path): a dot-
-    carrying loop body whose ppermute operands reach only loop-carried
-    state is a ring step the latency-hiding scheduler may run under the
-    dots. Headline counts: ``ring_bodies`` (dot-carrying bodies with any
+    Since r12 a thin delegate to ``obs/hlo_report.ring_evidence`` (the
+    loop-body operand walk narrowed to ``collective-permute`` — the only
+    collective the ring kernels issue on the hot path): a dot-carrying
+    loop body whose ppermute operands reach only loop-carried state is a
+    ring step the latency-hiding scheduler may run under the dots.
+    Headline counts: ``ring_bodies`` (dot-carrying bodies with any
     ppermute) and ``independent_ring_bodies`` (all of whose ppermutes are
     compute-independent). Callers compare a forward-only lowering against
     the full train step to attribute bodies to fwd vs bwd (instruction
     text alone cannot).
     """
-    from .overlap import hlo_overlap_evidence
+    from ..obs.hlo_report import ring_evidence
 
-    ev = hlo_overlap_evidence(hlo_text, collectives=("collective-permute",))
-    bodies = ev["bodies"]
-    independent = [r for r in bodies
-                   if r["compute_independent_collectives"] > 0
-                   and r["compute_dependent_collectives"] == 0]
-    return {
-        "bodies": bodies,
-        "ring_bodies": len(bodies),
-        "independent_ring_bodies": len(independent),
-    }
+    return ring_evidence(hlo_text)

@@ -262,118 +262,18 @@ def overlap_scan(apply_fn: Callable[[Any, jax.Array, jax.Array, Any],
 
 # -- HLO schedule evidence -------------------------------------------------
 
+
 def hlo_overlap_evidence(hlo_text: str,
                          collectives: tuple[str, ...] | None = None,
                          ) -> dict[str, Any]:
     """Analyse compiled HLO for the decomposed schedule's signature.
 
-    For every non-entry computation that contains both matmuls and a
-    cross-replica collective (on this harness those are exactly the
-    layer-scan loop bodies, forward and backward), walk each collective's
-    operand chain and classify it as *compute-independent* (its inputs
-    reach only loop-carried state — the stacked params and the induction
-    variable, never a same-body dot) or *compute-dependent* (it consumes
-    this iteration's dots, e.g. the per-layer gradient reduction).
+    Since r12 this is a thin delegate: the operand-chain walker moved to
+    ``obs/hlo_report.collective_evidence`` so the production
+    ``--hlo_report`` tripwire and the bench legs share ONE analysis (this
+    spelling and its semantics are unchanged — headline booleans
+    ``prefetch_gather_independent`` / ``bwd_regather_independent``, and
+    the ``collectives=`` override ``parallel/compress.py`` uses)."""
+    from ..obs.hlo_report import collective_evidence
 
-    A compute-independent collective inside a dot-carrying loop body is
-    the schedulability witness: the latency-hiding scheduler may start it
-    at the top of the iteration and run the matmuls under it — the
-    layer-(k+1) weight gather issued before layer k's compute retires.
-    Dependent collectives (the backward grad drain) can only overlap
-    ACROSS iterations (start in iteration k, complete during k-1), which
-    instruction-level text cannot prove; their presence and count are
-    reported as-is. Whether overlap then *happens* is a
-    scheduler/hardware property — measured on TPU by
-    tools/tpu_followup_r8.sh; this function proves what the CPU host can:
-    the dataflow freedom exists.
-
-    Headline booleans: ``prefetch_gather_independent`` (≥1 loop body has
-    a compute-independent collective — the forward prefetch) and
-    ``bwd_regather_independent`` (≥2 such bodies — the backward re-gather
-    pipeline too).
-
-    ``collectives`` overrides the default op set — ``parallel/compress.py``
-    adds ``all-to-all`` (its reduce-scatter phase) when analysing the
-    compressed-DDP schedule.
-    """
-    import re
-
-    if collectives is None:
-        collectives = ("all-reduce", "all-gather", "reduce-scatter",
-                       "collective-permute")
-    bodies = []
-    cur: list[str] | None = None
-    name = ""
-    for line in hlo_text.splitlines():
-        stripped = line.strip()
-        if stripped.endswith("{") and ("(" in stripped and "->" in stripped):
-            cur = []
-            name = stripped.split(" ", 1)[0]
-            continue
-        if stripped == "}" or stripped.startswith("}"):
-            if cur:
-                bodies.append((name, cur))
-            cur = None
-            continue
-        if cur is not None and "=" in stripped:
-            cur.append(stripped)
-
-    def is_dot(s: str) -> bool:
-        return " dot(" in s or " convolution(" in s
-
-    def is_collective(s: str) -> bool:
-        return any(f" {c}(" in s or f" {c}-start(" in s
-                   for c in collectives)
-
-    token = re.compile(r"%[\w.\-]+")
-    rows = []
-    for body_name, instrs in bodies:
-        if body_name.upper().startswith("ENTRY"):
-            # entry holds the pre-loop warm gather and the optimizer
-            # tail — not a layer-schedule witness either way
-            continue
-        defs: dict[str, tuple[list[str], str]] = {}
-        for s in instrs:
-            lhs, _, rhs = s.partition("=")
-            names = token.findall(lhs)
-            if not names:
-                continue
-            # operands: %refs on the RHS; refs to other computations
-            # (calls=, to_apply=) simply miss the defs map and end the walk
-            defs[names[0]] = (token.findall(rhs), s)
-        dot_names = {n for n, (_, s) in defs.items() if is_dot(s)}
-        coll_names = [n for n, (_, s) in defs.items() if is_collective(s)]
-        if not dot_names or not coll_names:
-            continue
-
-        dep_cache: dict[str, bool] = {}
-
-        def depends_on_dot(n: str) -> bool:
-            if n in dep_cache:
-                return dep_cache[n]
-            dep_cache[n] = False  # cycles impossible in HLO; guards re-entry
-            if n in dot_names:
-                dep_cache[n] = True
-                return True
-            ops = defs.get(n, ([], ""))[0]
-            dep_cache[n] = any(depends_on_dot(o) for o in ops)
-            return dep_cache[n]
-
-        independent = [n for n in coll_names
-                       if not any(depends_on_dot(o)
-                                  for o in defs[n][0])]
-        rows.append({
-            "computation": body_name,
-            "dots": len(dot_names),
-            "collectives": len(coll_names),
-            "compute_independent_collectives": len(independent),
-            "compute_dependent_collectives":
-                len(coll_names) - len(independent),
-        })
-    with_indep = [r for r in rows
-                  if r["compute_independent_collectives"] > 0]
-    return {
-        "bodies": rows,
-        "prefetch_gather_independent": len(with_indep) >= 1,
-        "bwd_regather_independent": len(with_indep) >= 2,
-    }
+    return collective_evidence(hlo_text, collectives=collectives)

@@ -535,77 +535,18 @@ class DdpSchedule:
 
 # -- composed-schedule HLO evidence ----------------------------------------
 
+
 def hlo_composed_evidence(hlo_text: str) -> dict[str, Any]:
     """Witness that a composed (fsdp×tp) lowering carries BOTH axes'
     collectives compute-independent in ONE scanned body.
 
-    Two operand walks over the same HLO
-    (``overlap.hlo_overlap_evidence``): the *gather family* (all-reduce/
-    all-gather/reduce-scatter/all-to-all — the data-axis fsdp/ddp
-    collectives) and the *ring family* (collective-permute — the
-    model-axis TP hops). The TP rings lower to nested loop computations
-    called FROM the layer-scan body, so "one scanned body" means: a
-    dot-carrying loop body whose gather collectives are compute-
-    independent AND that either contains independent ppermutes directly
-    or calls a nested ring body all of whose ppermutes are independent.
-    ``composed_overlap_independent`` is the headline boolean.
-    """
-    import re
+    Since r12 a thin delegate to ``obs/hlo_report.composed_evidence``
+    (the two-family operand walk + nested-computation reachability moved
+    there so the production ``--hlo_report`` tripwire and the
+    ``BENCH_MODE=overlap3d`` leg share ONE analysis). Semantics and keys
+    unchanged: ``independent_gather_bodies`` / ``independent_ring_bodies``
+    / ``bodies_with_both_independent`` and the headline boolean
+    ``composed_overlap_independent``."""
+    from ..obs.hlo_report import composed_evidence
 
-    from .overlap import hlo_overlap_evidence
-
-    gather_ev = hlo_overlap_evidence(
-        hlo_text, collectives=("all-reduce", "all-gather",
-                               "reduce-scatter", "all-to-all"))
-    ring_ev = hlo_overlap_evidence(hlo_text,
-                                   collectives=("collective-permute",))
-
-    def norm(name: str) -> str:
-        return name.lstrip("%")
-
-    gather_ind = {norm(r["computation"]) for r in gather_ev["bodies"]
-                  if r["compute_independent_collectives"] > 0}
-    ring_ind = {norm(r["computation"]) for r in ring_ev["bodies"]
-                if r["compute_independent_collectives"] > 0
-                and r["compute_dependent_collectives"] == 0}
-
-    # map each computation to the computations it references (while
-    # bodies, calls, fusions) so a gather body "contains" the ring
-    # bodies its nested loops execute
-    refs: dict[str, set[str]] = {}
-    cur: str | None = None
-    ref_re = re.compile(
-        r"(?:body|condition|to_apply|calls|branch_computations)="
-        r"[{(]?%?([\w.\-]+)")
-    for line in hlo_text.splitlines():
-        stripped = line.strip()
-        if stripped.endswith("{") and "(" in stripped and "->" in stripped:
-            cur = norm(stripped.split(" ", 1)[0])
-            refs[cur] = set()
-            continue
-        if stripped.startswith("}"):
-            cur = None
-            continue
-        if cur is not None:
-            refs[cur].update(ref_re.findall(stripped))
-
-    def reaches_ring(name: str, seen: set[str]) -> bool:
-        if name in ring_ind:
-            return True
-        if name in seen:
-            return False
-        seen.add(name)
-        return any(reaches_ring(r, seen) for r in refs.get(name, ()))
-
-    both = sorted(
-        b for b in gather_ind
-        if b in ring_ind or reaches_ring(b, set())
-    )
-    return {
-        "gather_bodies": gather_ev["bodies"],
-        "ring_bodies": ring_ev["bodies"],
-        "independent_gather_bodies": len(gather_ind),
-        "independent_ring_bodies": len(ring_ind),
-        "bodies_with_both_independent": both,
-        "composed_overlap_independent": len(both) >= 1,
-    }
+    return composed_evidence(hlo_text)

@@ -31,10 +31,12 @@ which in steady state has already retired and costs ~nothing.
 
 from __future__ import annotations
 
+import json
 import signal
 import threading
 import time
 from collections import deque
+from pathlib import Path
 from typing import Any, Callable
 
 import flax.struct
@@ -50,12 +52,18 @@ from ..data.loader import ShardedLoader
 from ..models.task import Task
 from ..runtime.context import DATA_AXIS, RuntimeContext
 from ..utils import get_logger, is_main_process
+from ..obs.health import HEALTH_KEYS
 from ..utils.divergence import DivergenceMonitor
 from ..utils.profiler import StepTimer, TraceWindow
 from .metrics import MetricsWriter, SyncTelemetry, make_telemetry
 from .schedule import SCHEDULES
 
 log = get_logger(__name__)
+
+#: the per-step scalars handed to the anomaly sentry (``kind="health"``
+#: telemetry records): loss/grad_norm for the spike detector plus the
+#: whole health pack for the flight-record ring buffer
+SENTRY_FEED_KEYS = ("loss", "grad_norm") + HEALTH_KEYS
 
 
 class TrainState(flax.struct.PyTreeNode):
@@ -150,8 +158,18 @@ def make_train_step(
     schedule: optax.Schedule,
     accum_steps: int = 1,
     with_stop: bool = False,
+    health: bool = False,
 ) -> Callable[..., tuple[TrainState, dict[str, jax.Array]]]:
     """Build the jitted SPMD train step.
+
+    ``health=True`` (the default production Trainer path, ``--health_pack``)
+    extends the step metrics with the device-side health bundle
+    (``obs/health.py``: param norm, update ratio, non-finite counts,
+    per-layer grad norms for scanned stacks, EF-residual norm) — a few
+    fused reductions computed where the operands already live, drained
+    through the telemetry channel like every other metric: zero extra
+    host syncs. Default False so direct callers (bench parity legs,
+    tests) keep their metric trees bit-stable.
 
     ``with_stop=True`` (multi-process runs) adds a third argument — the
     :func:`make_stop_flags` votes array — and a ``stop_agreed`` entry in
@@ -266,6 +284,12 @@ def make_train_step(
         out_metrics.setdefault("loss", loss)
         out_metrics["grad_norm"] = grad_norm
         out_metrics["lr"] = schedule(state.step)
+        if health:
+            from ..obs.health import health_metrics
+
+            out_metrics.update(health_metrics(
+                loss=loss, grads=grads, params=state.params,
+                updates=updates, residual=new_residual))
         if stop_flags is not None:
             # device-side stop agreement: OR of every process's vote.
             # Replicated output — each host reads the identical value, so
@@ -336,7 +360,7 @@ class Trainer:
         self._stop_votes: dict[bool, jax.Array] = {}
         self.train_step = make_train_step(
             task, self.tx, self.schedule, config.gradient_accumulation_steps,
-            with_stop=self._with_stop,
+            with_stop=self._with_stop, health=config.health_pack,
         )
         self.eval_step = make_eval_step(task)
         self.ckpt = CheckpointManager(
@@ -349,6 +373,30 @@ class Trainer:
         # percentiles with side-work intervals discarded
         self.step_timer = StepTimer()
         self.divergence = DivergenceMonitor(lag=max(config.max_inflight_steps, 1))
+        # anomaly sentry + flight recorder (--anomaly warn|halt): the
+        # sentry consumes the per-step health feed ON the telemetry drain
+        # thread (kind="health" records route to on_health, never to the
+        # writer); the loop polls its trigger once per iteration. Every
+        # process runs its own sentry over the replicated scalars — the
+        # halt agreement still travels device-side, so a lone divergent
+        # host cannot split the fleet's stop decision.
+        self.sentry = None
+        self.recorder = None
+        if config.anomaly != "off":
+            from ..obs.sentry import AnomalySentry, FlightRecorder
+
+            self.sentry = AnomalySentry(
+                config.anomaly, window=config.anomaly_window,
+                threshold=config.anomaly_threshold)
+            self.telemetry.on_health = self.sentry.observe
+            self.recorder = FlightRecorder(config.output_dir)
+        # halt machinery: _halt_vote feeds the device-side stop agreement
+        # (multi-process) / the local stop check (single-process) once the
+        # post-trigger flight trace has its steps; _flight_trace is armed
+        # by the trigger handler and stepped by the loop
+        self._halt_vote = False
+        self._halt_at_step: int | None = None
+        self._flight_trace: TraceWindow | None = None
 
     # -- state ------------------------------------------------------------
     def init_state(self) -> TrainState:
@@ -533,6 +581,14 @@ class Trainer:
             },
         )
 
+        if cfg.hlo_report:
+            # best-effort by design: a report/tripwire failure must never
+            # cost the training run it exists to protect
+            try:
+                self._emit_hlo_report(state)
+            except Exception:  # noqa: BLE001
+                log.exception("--hlo_report failed; continuing without it")
+
         # graceful preemption (SLURM/TPU-VM maintenance send SIGTERM):
         # finish the in-flight step, checkpoint, exit cleanly — the next
         # run auto-resumes. The reference's pre-elastic launcher just dies
@@ -571,7 +627,11 @@ class Trainer:
         full-loop leg so the bench drives the exact production dispatch
         path."""
         if self._with_stop:
-            local = stop_signal is not None and stop_signal["sig"] is not None
+            # the anomaly-halt vote rides the same channel as SIGTERM: a
+            # True from EITHER source reaches every host as one device-
+            # side OR, so the fleet stops at the identical lagged step
+            local = (stop_signal is not None
+                     and stop_signal["sig"] is not None) or self._halt_vote
             votes = self._stop_votes.get(local)
             if votes is None:
                 votes = self._stop_votes[local] = make_stop_flags(
@@ -621,135 +681,323 @@ class Trainer:
         start_epoch = start_step // self.steps_per_epoch
         global_step = start_step
         done = False
-        for epoch in range(start_epoch, self.num_epochs):
-            # on resume mid-epoch, drop already-consumed batches in the
-            # loader (before generation/transfer) so the data order matches
-            # an uninterrupted run
-            skip = start_step % self.steps_per_epoch if epoch == start_epoch else 0
-            for batch in self.loader.epoch(epoch, start_batch=skip):
-                trace.step(global_step)
-                state, metrics, fence = self._dispatch(state, batch, stop_signal)
-                # an interval that included eval/save/divergence work last
-                # iteration is not a step time — keep percentiles honest
-                timer.tick(discard=side_work)
-                side_work = False
-                global_step += 1
-                inflight.append((global_step, fence))
-                if cfg.logging_steps:  # window only consumed when logging
-                    window.append(metrics["loss"])
-                if pbar is not None:
-                    pbar.update(1)
+        # the loop proper runs under a crash guard: an exception mid-loop
+        # must still stop any live profiler trace (losing the partially
+        # captured profile of a crashed run loses the one you want most)
+        # and give the flight recorder its chance to dump the ring buffer
+        try:
+            for epoch in range(start_epoch, self.num_epochs):
+                # on resume mid-epoch, drop already-consumed batches in the
+                # loader (before generation/transfer) so the data order matches
+                # an uninterrupted run
+                skip = start_step % self.steps_per_epoch if epoch == start_epoch else 0
+                for batch in self.loader.epoch(epoch, start_batch=skip):
+                    # flight trace first: if its window ends exactly where
+                    # the main --profile_steps window begins, it must stop
+                    # before trace.step() starts the next capture (one
+                    # live profiler trace per process)
+                    if self._flight_trace is not None:
+                        self._flight_trace.step(global_step)
+                    trace.step(global_step)
+                    state, metrics, fence = self._dispatch(state, batch, stop_signal)
+                    # an interval that included eval/save/divergence work last
+                    # iteration is not a step time — keep percentiles honest
+                    timer.tick(discard=side_work)
+                    side_work = False
+                    global_step += 1
+                    inflight.append((global_step, fence))
+                    if cfg.logging_steps:  # window only consumed when logging
+                        window.append(metrics["loss"])
+                    if self.sentry is not None:
+                        # per-step health feed: device arrays into the
+                        # telemetry queue (a dict build + queue put — the
+                        # drain thread does the host conversion and hands
+                        # the floats to the sentry; kind="health" records
+                        # never hit the JSONL writer)
+                        telemetry.emit(
+                            global_step,
+                            {k: metrics[k] for k in SENTRY_FEED_KEYS
+                             if k in metrics},
+                            kind="health")
+                    if pbar is not None:
+                        pbar.update(1)
 
-                stop_now = False
-                if paced:
-                    while len(inflight) > max_inflight:
-                        _, fval = inflight.popleft()
-                        # the barrier: one scalar host read of a step K
-                        # dispatches old — complete in steady state
-                        fval = jax.device_get(fval)
-                        if self._with_stop and int(fval):
-                            stop_now = True
-                else:
-                    while len(inflight) > max_inflight:
-                        inflight.popleft()
-                if not self._with_stop and stop_signal["sig"] is not None:
-                    # host-local decision; no device round-trip involved
-                    stop_now = True
-
-                if cfg.logging_steps and global_step % cfg.logging_steps == 0:
-                    if isinstance(telemetry, SyncTelemetry):
-                        # pre-async behaviour, kept bit-faithful for the
-                        # host_overhead_pct before-leg: device mean, then
-                        # the sink's inline float() blocks on the step
-                        loss_val: Any = jnp.mean(jnp.stack(window))
-                        timer_val: Any = timer.summary()
+                    stop_now = False
+                    if paced:
+                        while len(inflight) > max_inflight:
+                            _, fval = inflight.popleft()
+                            # the barrier: one scalar host read of a step K
+                            # dispatches old — complete in steady state
+                            fval = jax.device_get(fval)
+                            if self._with_stop and int(fval):
+                                stop_now = True
                     else:
-                        # hand the raw per-step device scalars to the
-                        # drain thread (it averages after device_get) and
-                        # defer the percentile math over a snapshot taken
-                        # NOW: zero extra dispatches, zero numpy on the
-                        # hot loop, and the record stays tied to its step
-                        # even if the drain lags
-                        loss_val = window
-                        timer_val = timer.deferred_summary()
-                    window = []  # the sink owns the old list now
-                    now = time.perf_counter()
-                    steps_per_s = cfg.logging_steps / (now - t_last)
-                    t_last = now
-                    wait_now = self.loader.stats["consumer_wait_s"]
-                    scalars = {
-                        "loss": loss_val,
-                        "lr": metrics["lr"],
-                        "grad_norm": metrics["grad_norm"],
-                        "steps_per_sec": steps_per_s,
-                        "examples_per_sec": steps_per_s * examples_per_step,
-                        "input_wait_ms": 1e3 * (wait_now - wait_last)
-                        / cfg.logging_steps,
-                        "timer": timer_val,
-                    }
-                    wait_last = wait_now
-                    telemetry.emit(global_step, scalars, kind="progress")
-                    # snapshot: the drain thread rebinds .latest (possibly
-                    # to an eval record with no 'loss') between a check
-                    # and an index
-                    latest = telemetry.latest
-                    if pbar is not None and "loss" in latest:
-                        # lagged by design: the async contract trades a
-                        # stale postfix for an unstalled dispatch pipeline
-                        pbar.set_postfix(loss=f"{latest['loss']:.4f}")
+                        while len(inflight) > max_inflight:
+                            inflight.popleft()
+                    if not self._with_stop and stop_signal["sig"] is not None:
+                        # host-local decision; no device round-trip involved
+                        stop_now = True
+                    if (not self._with_stop and self._halt_at_step is not None
+                            and global_step >= self._halt_at_step):
+                        # single-process anomaly halt: stop once the
+                        # post-trigger flight trace has its steps (the
+                        # multi-process path stops via the vote agreement)
+                        stop_now = True
 
-                if cfg.eval_steps and global_step % cfg.eval_steps == 0:
-                    side_work = True
-                    ev = self.evaluate(state)
-                    if ev:
-                        telemetry.emit(global_step, ev, kind="eval")
+                    if self.sentry is not None and self.sentry.triggered:
+                        trig = self.sentry.poll_trigger()
+                        if trig is not None:
+                            self._on_anomaly_trigger(state, trig,
+                                                     global_step, trace)
 
-                if (cfg.divergence_check_steps
-                        and global_step % cfg.divergence_check_steps == 0):
-                    # SPMD desync detector: dispatch the fingerprint now
-                    # (async); the fetch+allgather completes via poll() once
-                    # it is max_inflight steps old — off the critical path
-                    self.divergence.submit(state.params, global_step)
-                if self.divergence.poll(global_step) is not None:
-                    side_work = True  # the DCN allgather ran this iteration
+                    if cfg.logging_steps and global_step % cfg.logging_steps == 0:
+                        if isinstance(telemetry, SyncTelemetry):
+                            # pre-async behaviour, kept bit-faithful for the
+                            # host_overhead_pct before-leg: device mean, then
+                            # the sink's inline float() blocks on the step
+                            loss_val: Any = jnp.mean(jnp.stack(window))
+                            timer_val: Any = timer.summary()
+                        else:
+                            # hand the raw per-step device scalars to the
+                            # drain thread (it averages after device_get) and
+                            # defer the percentile math over a snapshot taken
+                            # NOW: zero extra dispatches, zero numpy on the
+                            # hot loop, and the record stays tied to its step
+                            # even if the drain lags
+                            loss_val = window
+                            timer_val = timer.deferred_summary()
+                        window = []  # the sink owns the old list now
+                        now = time.perf_counter()
+                        steps_per_s = cfg.logging_steps / (now - t_last)
+                        t_last = now
+                        wait_now = self.loader.stats["consumer_wait_s"]
+                        scalars = {
+                            "loss": loss_val,
+                            "lr": metrics["lr"],
+                            "grad_norm": metrics["grad_norm"],
+                            "steps_per_sec": steps_per_s,
+                            "examples_per_sec": steps_per_s * examples_per_step,
+                            "input_wait_ms": 1e3 * (wait_now - wait_last)
+                            / cfg.logging_steps,
+                            "timer": timer_val,
+                        }
+                        # the health pack rides the progress record at the
+                        # logging cadence (point sample of the latest step,
+                        # like lr/grad_norm) — the durable metrics.jsonl
+                        # channel for the new fields
+                        for k in HEALTH_KEYS:
+                            if k in metrics:
+                                scalars[k] = metrics[k]
+                        wait_last = wait_now
+                        telemetry.emit(global_step, scalars, kind="progress")
+                        # snapshot: the drain thread rebinds .latest (possibly
+                        # to an eval record with no 'loss') between a check
+                        # and an index
+                        latest = telemetry.latest
+                        if pbar is not None and "loss" in latest:
+                            # lagged by design: the async contract trades a
+                            # stale postfix for an unstalled dispatch pipeline
+                            pbar.set_postfix(loss=f"{latest['loss']:.4f}")
 
-                if cfg.save_steps and global_step % cfg.save_steps == 0:
-                    # async orbax save: schedule-and-return. Only discard
-                    # the next timer interval if scheduling actually
-                    # stalled (e.g. waiting out the previous save) — an
-                    # unconditional discard would blind the percentiles to
-                    # every save-adjacent step
-                    t_save = time.perf_counter()
-                    self.ckpt.save(global_step, state, cfg)
-                    save_ms = 1e3 * (time.perf_counter() - t_save)
-                    p50 = timer.p50_ms() if self.ckpt.is_async else None
-                    side_work = side_work or p50 is None or \
-                        save_ms > max(0.25 * p50, 1.0)
+                    if cfg.eval_steps and global_step % cfg.eval_steps == 0:
+                        side_work = True
+                        ev = self.evaluate(state)
+                        if ev:
+                            telemetry.emit(global_step, ev, kind="eval")
 
-                if stop_now:
-                    if stop_signal["sig"] is None:
-                        # a peer was signalled; record it so the log is honest
-                        stop_signal["sig"] = int(signal.SIGTERM)
-                    log.warning(
-                        "termination signal received — checkpointing and "
-                        "exiting for clean resume",
-                        {"signal": stop_signal["sig"], "step": global_step},
-                    )
-                    done = True
+                    if (cfg.divergence_check_steps
+                            and global_step % cfg.divergence_check_steps == 0):
+                        # SPMD desync detector: dispatch the fingerprint now
+                        # (async); the fetch+allgather completes via poll() once
+                        # it is max_inflight steps old — off the critical path
+                        self.divergence.submit(state.params, global_step)
+                    if self.divergence.poll(global_step) is not None:
+                        side_work = True  # the DCN allgather ran this iteration
+
+                    if cfg.save_steps and global_step % cfg.save_steps == 0:
+                        # async orbax save: schedule-and-return. Only discard
+                        # the next timer interval if scheduling actually
+                        # stalled (e.g. waiting out the previous save) — an
+                        # unconditional discard would blind the percentiles to
+                        # every save-adjacent step
+                        t_save = time.perf_counter()
+                        self.ckpt.save(global_step, state, cfg)
+                        save_ms = 1e3 * (time.perf_counter() - t_save)
+                        p50 = timer.p50_ms() if self.ckpt.is_async else None
+                        side_work = side_work or p50 is None or \
+                            save_ms > max(0.25 * p50, 1.0)
+
+                    if stop_now:
+                        if self._halt_vote and stop_signal["sig"] is None:
+                            # the sentry, not a scheduler, stopped this run
+                            log.error(
+                                "anomaly halt — checkpointing and exiting "
+                                "(triage bundle in flight_records/)",
+                                {"step": global_step},
+                            )
+                        else:
+                            if stop_signal["sig"] is None:
+                                # a peer was signalled; record it so the log
+                                # is honest
+                                stop_signal["sig"] = int(signal.SIGTERM)
+                            log.warning(
+                                "termination signal received — checkpointing "
+                                "and exiting for clean resume",
+                                {"signal": stop_signal["sig"],
+                                 "step": global_step},
+                            )
+                        done = True
+                        break
+
+                    if global_step >= self.total_steps:
+                        done = True
+                        break
+                if done:
                     break
+        except BaseException as exc:
+            # the crashed run's ring buffer IS the triage artifact: dump
+            # it (best-effort — state may be poisoned or donated mid-step)
+            # before the exception propagates to train()'s finally
+            if self.recorder is not None:
+                try:
+                    self._dump_flight_record(state, {
+                        "step": global_step,
+                        "reasons": [f"exception: {exc!r}"],
+                        "mode": "crash",
+                        "time": time.time(),
+                    }, fingerprint_ok=False)
+                except Exception:  # noqa: BLE001
+                    log.exception("crash flight-record dump failed")
+            raise
+        finally:
+            # crash or not: stop any live profiler capture so the partial
+            # trace is written out (a crashed run's profile is the one you
+            # want most), and release the progress bar
+            if pbar is not None:
+                pbar.close()
+            trace.close()
+            if self._flight_trace is not None:
+                self._flight_trace.close()
 
-                if global_step >= self.total_steps:
-                    done = True
-                    break
-            if done:
-                break
-
-        if pbar is not None:
-            pbar.close()
-        trace.close()
         self.divergence.drain()  # identical pending set on every process
         if self.ckpt.latest_step() != global_step:  # avoid duplicate final save
             self.ckpt.save(global_step, state, cfg, force=True)
         self.ckpt.wait()
         log.info("training complete", {"global_step": global_step})
         return state
+
+    # -- observability ----------------------------------------------------
+    def _on_anomaly_trigger(self, state, trig, global_step, main_trace):
+        """Handle a sentry trigger on the loop thread: dump the triage
+        bundle, arm a short profiler capture over the NEXT few steps into
+        the bundle directory, and (halt mode) schedule the coherent stop."""
+        from ..obs.sentry import FLIGHT_TRACE_STEPS
+
+        flight_dir = None
+        try:
+            flight_dir = self._dump_flight_record(state, trig)
+        except Exception:  # noqa: BLE001 - triage must not kill training
+            log.exception("flight-record dump failed")
+        # one live jax-profiler trace per process: skip the capture when
+        # the --profile_steps window is mid-capture OR would OPEN inside
+        # the flight window [global_step, global_step+N) — starting a
+        # second trace raises, and the crash guard would then kill a run
+        # that warn mode promises never to cost
+        main_overlaps = (
+            main_trace.enabled
+            and main_trace.stop_at > global_step
+            and main_trace.start < global_step + FLIGHT_TRACE_STEPS)
+        if (flight_dir is not None and self._flight_trace is None
+                and not main_overlaps):
+            # start_step = the CURRENT counter: the next iteration's
+            # loop-top step() call still carries this value (the counter
+            # increments after dispatch), so capture starts immediately
+            self._flight_trace = TraceWindow(
+                flight_dir, start_step=global_step,
+                num_steps=FLIGHT_TRACE_STEPS)
+        elif flight_dir is not None and main_overlaps:
+            log.info(
+                "flight-record trace skipped: --profile_steps window "
+                "overlaps the post-trigger capture",
+                {"step": global_step, "profile_window":
+                 [main_trace.start, main_trace.stop_at]})
+        if self.sentry.mode == "halt":
+            # vote now (multi-process: the device-side OR reaches every
+            # host through the dispatch-depth barrier within K steps);
+            # single-process: stop once the flight trace has its steps —
+            # the +1 lets the window's own stop_at boundary close the
+            # trace cleanly before the halt breaks the loop
+            self._halt_vote = True
+            self._halt_at_step = global_step + FLIGHT_TRACE_STEPS + 1
+
+    def _dump_flight_record(self, state, trigger, *,
+                            fingerprint_ok: bool = True):
+        """Write the triage bundle for ``trigger``; returns its directory
+        (None when no recorder is configured). ``fingerprint_ok=False``
+        skips the device fetch — crash dumps must not touch possibly
+        donated/poisoned buffers."""
+        if self.recorder is None:
+            return None
+        from ..parallel.sharding import describe
+        from ..utils.divergence import fingerprint
+
+        desc = None
+        try:
+            desc = describe(self.ctx.mesh, self.config, state.params,
+                            model=self.task.model)
+        except Exception:  # noqa: BLE001
+            log.exception("describe() snapshot failed for flight record")
+        fp = None
+        if fingerprint_ok:
+            try:
+                # a device fetch, but a triggered run is past caring about
+                # dispatch-depth discipline; NaNs in the digest serialise
+                # as null+repr via the recorder's sanitiser
+                fp = [float(x) for x in
+                      np.asarray(jax.device_get(fingerprint(state.params)))]
+            except Exception:  # noqa: BLE001
+                log.exception("fingerprint failed for flight record")
+        ring = self.sentry.records() if self.sentry is not None else []
+        return self.recorder.dump(
+            step=int(trigger.get("step", 0)), trigger=trigger, ring=ring,
+            config=self.config, describe_snapshot=desc, fingerprint=fp)
+
+    def _emit_hlo_report(self, state):
+        """``--hlo_report``: compile the train step ahead of the loop and
+        write the schedule report + tripwire warnings (obs/hlo_report.py)
+        to ``<output_dir>/hlo_report.json``. Costs one extra ahead-of-time
+        compilation (the loop's first call still compiles through the jit
+        cache); opt-in for exactly that reason."""
+        from ..obs.hlo_report import check_overlap_expectations, schedule_report
+
+        example = next(iter(self.loader.epoch(0)))
+        args = [state, example]
+        if self._with_stop:
+            args.append(make_stop_flags(self.ctx.mesh, False))
+        t0 = time.perf_counter()
+        compiled = self.train_step.lower(*args).compile()
+        report = schedule_report(compiled.as_text())
+        report["compile_s"] = round(time.perf_counter() - t0, 2)
+        warnings = check_overlap_expectations(
+            report, self.config, dict(self.ctx.mesh.shape))
+        report["warnings"] = warnings
+        if is_main_process():
+            path = Path(self.config.output_dir) / "hlo_report.json"
+            path.parent.mkdir(parents=True, exist_ok=True)
+            path.write_text(json.dumps(report, indent=2))
+        log.info("HLO schedule report", {
+            "collective_ops": {k: v["count"]
+                               for k, v in report["ops"].items()},
+            "wire_mb_estimate": report["wire_mb_estimate"],
+            "gather_independent_bodies":
+                report["gather"]["independent_bodies"],
+            "independent_ring_bodies":
+                report["ring"]["independent_ring_bodies"],
+            "composed_overlap_independent":
+                report["composed"]["composed_overlap_independent"],
+            "warnings": len(warnings),
+            "report": str(Path(self.config.output_dir) / "hlo_report.json"),
+        })
+        for w in warnings:
+            log.warning("schedule tripwire: " + w)
+        return report

@@ -36,12 +36,22 @@ from pathlib import Path
 from typing import Any, Callable
 
 from ..utils import get_logger, is_main_process
+from ..utils.serialization import json_sanitize
 
 log = get_logger(__name__)
 
 
 class MetricsWriter:
-    """Host-0 scalar writer: TensorBoard events (if available) + JSONL."""
+    """Host-0 scalar writer: TensorBoard events (if available) + JSONL.
+
+    JSONL values may be scalars or flat lists (the r12 health pack's
+    ``per_layer_grad_norm`` vector); lists go to JSONL only (TensorBoard
+    scalars are scalars). Non-finite values are serialised as ``null``
+    with the original spelling in a ``"<key>_repr"`` sibling
+    (``utils/serialization.json_sanitize``): the anomaly sentry
+    intentionally surfaces NaNs, and ``json.dumps``'s bare ``NaN`` token
+    would break every downstream JSON parser on exactly the record that
+    matters most."""
 
     def __init__(self, directory: str | Path):
         self.active = is_main_process()
@@ -62,10 +72,20 @@ class MetricsWriter:
         if not self.active:
             return
         record = {"step": step, "time": time.time()}
-        record.update({k: float(v) for k, v in scalars.items()})
-        self._jsonl.write(json.dumps(record) + "\n")
+        record.update({
+            k: [float(x) for x in v] if isinstance(v, (list, tuple))
+            else float(v)
+            for k, v in scalars.items()
+        })
+        # allow_nan=False is the enforcement: a non-finite value that
+        # somehow dodged the sanitiser raises HERE (and the telemetry
+        # sink logs-and-drops) instead of corrupting the JSONL stream
+        self._jsonl.write(json.dumps(json_sanitize(record),
+                                     allow_nan=False) + "\n")
         if self._tb is not None:
             for k, v in scalars.items():
+                if isinstance(v, (list, tuple)):
+                    continue  # vectors are a JSONL-only channel
                 self._tb.add_scalar(k, float(v), global_step=step)
 
     def close(self) -> None:
@@ -76,10 +96,16 @@ class MetricsWriter:
             self._tb.close()
 
 
-def _fetch(v: Any) -> float:
+def _fetch(v: Any):
+    """Host-convert one value: device/host scalars → float, device/host
+    VECTORS (the per-layer health channel) → flat list of floats."""
     import jax
+    import numpy as np
 
-    return float(jax.device_get(v)) if isinstance(v, jax.Array) else float(v)
+    if isinstance(v, (jax.Array, np.ndarray)):
+        arr = np.asarray(jax.device_get(v))
+        return [float(x) for x in arr.ravel()] if arr.ndim else float(arr)
+    return float(v)
 
 
 def _to_host(scalars: dict[str, Any]) -> dict[str, float]:
@@ -111,6 +137,13 @@ def _to_host(scalars: dict[str, Any]) -> dict[str, float]:
 #: performed the host conversion (the drain thread for AsyncTelemetry)
 OnWrite = Callable[[str, int, dict[str, float]], None]
 
+#: health-record consumer: (step, host_scalars) — the anomaly sentry's
+#: ``observe``. ``kind="health"`` records route HERE instead of the
+#: writer: they flow every step (the sentry's per-step feed) and would
+#: otherwise multiply the metrics.jsonl volume by logging_steps; the
+#: logging-boundary progress record carries the same fields durably.
+OnHealth = Callable[[int, dict[str, Any]], None]
+
 
 class SyncTelemetry:
     """Inline sink: convert-and-write at emit time, blocking on the
@@ -123,9 +156,17 @@ class SyncTelemetry:
         self.writer = writer
         self.latest: dict[str, float] = {}
         self.on_write: OnWrite | None = None
+        self.on_health: OnHealth | None = None
 
     def emit(self, step: int, scalars: dict[str, Any],
              kind: str = "progress") -> None:
+        if kind == "health":
+            # inline conversion, like everything else in sync mode: the
+            # sentry still works, it just blocks on the in-flight step
+            # (the async sink is the production path — BENCH_MODE=obs)
+            if self.on_health is not None:
+                self.on_health(step, _to_host(scalars))
+            return
         host = _to_host(scalars)
         self.latest = host
         self.writer.write(step, host)
@@ -155,6 +196,7 @@ class AsyncTelemetry:
         self.writer = writer
         self.latest: dict[str, float] = {}
         self.on_write: OnWrite | None = None
+        self.on_health: OnHealth | None = None
         # bounded: if the writer ever falls an entire queue behind, emit
         # blocks rather than growing host buffers without limit
         self._q: queue.Queue = queue.Queue(maxsize=maxsize)
@@ -177,6 +219,18 @@ class AsyncTelemetry:
         self._q.put((kind, int(step), dict(scalars)))
 
     def _write_one(self, kind: str, step: int, scalars: dict[str, Any]) -> None:
+        if kind == "health":
+            # per-step sentry feed: converted on this (drain) thread —
+            # by now the producing step has retired, so the fetch is the
+            # same deferred-cost contract as every other record — and
+            # handed to the sentry, never to the writer (volume)
+            if self.on_health is None:
+                return
+            try:
+                self.on_health(step, _to_host(scalars))
+            except Exception:  # noqa: BLE001 - sentry must not kill drain
+                log.exception("health record dropped")
+            return
         if not self.writer.active and self.on_write is None:
             return  # non-main process: nothing consumes the conversion
         try:

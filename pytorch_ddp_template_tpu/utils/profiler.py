@@ -18,6 +18,7 @@ Two tools:
 from __future__ import annotations
 
 import time
+from collections import deque
 from pathlib import Path
 
 import jax
@@ -55,6 +56,14 @@ class TraceWindow:
             self._active = False
             log.info("profiler trace written", {"step": step, "dir": self.dir})
 
+    @property
+    def active(self) -> bool:
+        """True while a trace capture is running — the jax profiler
+        supports ONE live trace per process, so anything arming a second
+        window (the flight recorder's post-trigger capture) must check
+        here first."""
+        return self._active
+
     def close(self) -> None:
         if self._active:
             jax.profiler.stop_trace()
@@ -62,11 +71,15 @@ class TraceWindow:
 
 
 class StepTimer:
-    """Rolling wall-clock step timer with percentile summaries."""
+    """Rolling wall-clock step timer with percentile summaries.
+
+    The sample store is a bounded ``deque``: append past capacity evicts
+    the oldest sample in O(1) (a list's ``pop(0)`` is O(capacity) — paid
+    every step of a long run once the buffer fills), and the summaries
+    always describe the newest ``capacity`` recorded intervals."""
 
     def __init__(self, capacity: int = 2048):
-        self._times: list[float] = []
-        self._capacity = capacity
+        self._times: deque[float] = deque(maxlen=capacity)
         self._last: float | None = None
 
     def tick(self, *, discard: bool = False) -> float | None:
@@ -81,9 +94,7 @@ class StepTimer:
         if self._last is not None:
             dt = now - self._last
             if not discard:
-                if len(self._times) >= self._capacity:
-                    self._times.pop(0)
-                self._times.append(dt)
+                self._times.append(dt)  # maxlen evicts the oldest
         self._last = now
         return dt
 

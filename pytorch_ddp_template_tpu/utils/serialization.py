@@ -1,0 +1,55 @@
+"""JSON-safety for telemetry records that may carry non-finite floats.
+
+``json.dumps`` serialises NaN/Infinity as the bare tokens ``NaN`` /
+``Infinity`` — legal nowhere in the JSON spec, so any compliant consumer
+(``jq``, pandas ``read_json``, a Go/JS dashboard) chokes on the one record
+that mattered most: the step where the loss went NaN. The anomaly sentry
+*intentionally* surfaces non-finite scalars, so every sink that writes
+them (``train/metrics.MetricsWriter``, ``obs/sentry.FlightRecorder``)
+routes records through :func:`json_sanitize` first: the non-finite value
+becomes ``null`` and the original spelling is preserved in a sibling
+``"<key>_repr"`` string — machine-parseable AND lossless for the human
+reading the triage bundle.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+
+def _finite(v: float) -> bool:
+    return math.isfinite(v)
+
+
+def json_sanitize(record: dict[str, Any]) -> dict[str, Any]:
+    """Return a copy of ``record`` that ``json.dumps(..., allow_nan=False)``
+    accepts: non-finite floats become ``None`` plus a ``"<key>_repr"``
+    sibling holding the original spelling (``"nan"``, ``"inf"``, ``"-inf"``).
+    Lists are sanitised element-wise (one ``_repr`` for the whole list).
+    Nested dicts recurse. Non-numeric values pass through untouched.
+    """
+    out: dict[str, Any] = {}
+    for k, v in record.items():
+        if isinstance(v, bool) or v is None:
+            out[k] = v
+        elif isinstance(v, dict):
+            out[k] = json_sanitize(v)
+        elif isinstance(v, (list, tuple)):
+            vals = list(v)
+            bad = [x for x in vals
+                   if isinstance(x, float) and not _finite(x)]
+            if bad:
+                out[k] = [None if isinstance(x, float) and not _finite(x)
+                          else x for x in vals]
+                out[f"{k}_repr"] = ("["
+                                    + ", ".join(repr(x) for x in vals)
+                                    + "]")
+            else:
+                out[k] = vals
+        elif isinstance(v, float) and not _finite(v):
+            out[k] = None
+            out[f"{k}_repr"] = repr(v)  # 'nan' | 'inf' | '-inf'
+        else:
+            out[k] = v
+    return out

@@ -354,6 +354,64 @@ def test_overlap3d_record_committed_and_affirmative():
     assert last["vs_baseline"] >= 1.0
 
 
+@pytest.mark.slow
+def test_obs_mode_contract():
+    """BENCH_MODE=obs: one JSON line carrying the observability legs —
+    the health-pack+sentry overhead pair, the injected-NaN flight-record
+    completeness proof and the HLO census smoke (slow: a subprocess
+    compiling two train steps and driving a full Trainer run; the
+    committed record in bench_records/obs_cpu_r12.jsonl is the
+    tier-1-visible evidence)."""
+    code, lines, out = run_bench({
+        "BENCH_MODE": "obs", "BENCH_MODEL": "mlp",
+        "BENCH_BATCH": "8", "BENCH_WARMUP": "1", "BENCH_STEPS": "3",
+        "BENCH_NAN_STEP": "6", "BENCH_OUTPUT": "/tmp/bench_obs_contract",
+    })
+    assert code == 0, out[-2000:]
+    assert len(lines) == 1, out[-2000:]
+    row = lines[0]
+    assert REQUIRED <= set(row)
+    assert row["metric"] == "obs_overhead_ratio"
+    assert row["value"] > 0
+    assert row["sentry_false_positive"] is False
+    # the injected NaN produced a complete triage bundle and halted the
+    # run early through the production stop machinery
+    assert row["flight_bundle_complete"] is True, row["flight_bundle_files"]
+    assert row["flight_halted_early"] is True
+    assert row["flight_halted_at_step"] > row["nan_injected_at_step"]
+    for k in ("step_time_plain_ms", "step_time_obs_ms", "sentry_ring_len",
+              "hlo_collective_ops", "hlo_wire_mb_estimate"):
+        assert k in row, k
+
+
+def test_obs_record_committed_and_affirmative():
+    """The committed round-12 CPU record must exist and actually show the
+    evidence the round claims: health-pack+sentry step-time ratio within
+    the 0.9 band against sentry-off, no sentry false positive on the
+    healthy leg, and the injected-NaN run leaving a complete
+    flight-record bundle (all BUNDLE_FILES + the post-trigger trace)."""
+    import json
+    from pathlib import Path
+
+    from pytorch_ddp_template_tpu.obs.sentry import BUNDLE_FILES
+
+    path = Path(__file__).resolve().parent.parent / "bench_records" / \
+        "obs_cpu_r12.jsonl"
+    assert path.is_file(), "run BENCH_MODE=obs to record the legs"
+    records = [json.loads(l) for l in path.read_text().splitlines() if l]
+    assert records
+    last = records[-1]
+    assert last["metric"] == "obs_overhead_ratio"
+    assert last["value"] >= 0.9  # neutrality band: obs costs <= ~11%
+    assert last["vs_baseline"] >= 1.0
+    assert last["sentry_false_positive"] is False
+    assert last["sentry_ring_len"] > 0
+    assert last["flight_bundle_complete"] is True
+    assert last["flight_halted_early"] is True
+    assert set(BUNDLE_FILES) <= set(last["flight_bundle_files"])
+    assert "profile" in last["flight_bundle_files"]
+
+
 def test_comms_record_committed_and_affirmative():
     """The committed round-9 CPU record must exist and actually show the
     evidence the round claims: >= depth independent in-scan reduces, int8

@@ -1,13 +1,57 @@
-"""Profiler + divergence subsystems (SURVEY.md §5.1/§5.2 — absent in the
-reference, first-class here)."""
+"""Observability subsystems (SURVEY.md §5.1/§5.2 — absent in the
+reference, first-class here): profiler + divergence (r6) and the round-12
+flight recorder — in-step health pack, anomaly sentry, flight-record
+bundles, NaN-safe telemetry serialisation, and the HLO schedule report."""
+
+import json
+import math
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
+from pytorch_ddp_template_tpu.obs.health import HEALTH_KEYS, health_metrics
+from pytorch_ddp_template_tpu.obs.hlo_report import (
+    check_overlap_expectations,
+    collective_evidence,
+    op_census,
+    ring_evidence,
+    schedule_report,
+)
+from pytorch_ddp_template_tpu.obs.sentry import (
+    BUNDLE_FILES,
+    AnomalySentry,
+    FlightRecorder,
+)
 from pytorch_ddp_template_tpu.utils.divergence import check, fingerprint
 from pytorch_ddp_template_tpu.utils.profiler import StepTimer, TraceWindow
+from pytorch_ddp_template_tpu.utils.serialization import json_sanitize
 
+
+def make_trainer(tmp_path, **overrides):
+    from pytorch_ddp_template_tpu.config import TrainingConfig
+    from pytorch_ddp_template_tpu.models import build
+    from pytorch_ddp_template_tpu.runtime import make_mesh
+    from pytorch_ddp_template_tpu.runtime.context import RuntimeContext
+    from pytorch_ddp_template_tpu.train.engine import Trainer
+
+    defaults = dict(
+        model="mlp", dataset_size=256, per_device_train_batch_size=2,
+        logging_steps=0, save_steps=0, max_steps=10,
+        output_dir=str(tmp_path), resume=False,
+    )
+    defaults.update(overrides)
+    cfg = TrainingConfig(**defaults)
+    mesh = make_mesh("data:-1", jax.devices())
+    key = jax.random.PRNGKey(0)
+    ctx = RuntimeContext(mesh=mesh, seed_key=key,
+                         host_key=jax.random.fold_in(key, 0), config=cfg)
+    task, ds = build(cfg.model, cfg)
+    return Trainer(cfg, ctx, task, ds)
+
+
+# -- r6 subsystems ---------------------------------------------------------
 
 def test_fingerprint_detects_any_leaf_change():
     tree = {"a": jnp.arange(8.0), "b": {"w": jnp.ones((3, 3))}}
@@ -33,8 +77,36 @@ def test_step_timer_summary():
     assert all(v >= 0 for v in s.values())
 
 
+def test_step_timer_wraparound(monkeypatch):
+    """Capacity boundary: after more ticks than capacity, the oldest
+    samples are evicted and the summaries describe exactly the newest
+    ``capacity`` intervals (a long run's percentiles must track the
+    recent regime, not the whole history)."""
+    from pytorch_ddp_template_tpu.utils import profiler
+
+    # deterministic clock: tick i closes an interval of exactly i seconds
+    # (1, 2, ..., 9); capacity 4 must keep {6, 7, 8, 9}
+    times = iter([float(x) for x in np.cumsum([0] + list(range(1, 10)))])
+    monkeypatch.setattr(profiler.time, "perf_counter", lambda: next(times))
+    t = StepTimer(capacity=4)
+    for _ in range(10):
+        t.tick()
+    assert list(t._times) == [6.0, 7.0, 8.0, 9.0]
+    s = t.summary()
+    assert s["step_time_p50_ms"] == pytest.approx(7.5e3)
+    assert s["step_time_mean_ms"] == pytest.approx(7.5e3)
+    # the discard path still advances the boundary without recording
+    t2 = StepTimer(capacity=4)
+    times2 = iter([0.0, 1.0, 3.0])
+    monkeypatch.setattr(profiler.time, "perf_counter", lambda: next(times2))
+    t2.tick()
+    t2.tick(discard=True)
+    assert t2.tick() == pytest.approx(2.0)
+
+
 def test_trace_window_writes_profile(tmp_path):
     tw = TraceWindow(tmp_path, start_step=1, num_steps=2)
+    assert tw.active is False
     for step in range(5):
         tw.step(step)
         jnp.sum(jnp.arange(16.0)).block_until_ready()
@@ -45,22 +117,544 @@ def test_trace_window_writes_profile(tmp_path):
 
 
 def test_trainer_with_profiling_and_divergence(tmp_path):
-    from pytorch_ddp_template_tpu.config import TrainingConfig
-    from pytorch_ddp_template_tpu.models import build
-    from pytorch_ddp_template_tpu.runtime import make_mesh
-    from pytorch_ddp_template_tpu.runtime.context import RuntimeContext
-    from pytorch_ddp_template_tpu.train.engine import Trainer
-
-    cfg = TrainingConfig(
-        model="mlp", dataset_size=256, per_device_train_batch_size=2,
-        max_steps=14, logging_steps=5, save_steps=0, output_dir=str(tmp_path),
-        profile_steps=2, divergence_check_steps=5, resume=False,
-    )
-    mesh = make_mesh("data:-1", jax.devices())
-    key = jax.random.PRNGKey(0)
-    ctx = RuntimeContext(mesh=mesh, seed_key=key,
-                         host_key=jax.random.fold_in(key, 0), config=cfg)
-    task, ds = build("mlp", cfg)
-    state = Trainer(cfg, ctx, task, ds).train()
+    t = make_trainer(tmp_path, max_steps=14, logging_steps=5,
+                     profile_steps=2, divergence_check_steps=5)
+    state = t.train()
     assert int(state.step) == 14
     assert (tmp_path / "profile").exists()
+
+
+# -- NaN-safe serialisation (satellite: the sink must survive what the
+# sentry surfaces) ---------------------------------------------------------
+
+def test_json_sanitize_scalars_lists_nested():
+    rec = json_sanitize({
+        "ok": 1.5, "n": 3, "s": "x", "b": True, "none": None,
+        "bad": float("nan"), "inf": float("-inf"),
+        "vec": [1.0, float("inf"), 2.0],
+        "good_vec": [1.0, 2.0],
+        "nested": {"deep": float("nan")},
+    })
+    assert rec["ok"] == 1.5 and rec["n"] == 3 and rec["b"] is True
+    assert rec["bad"] is None and rec["bad_repr"] == "nan"
+    assert rec["inf"] is None and rec["inf_repr"] == "-inf"
+    assert rec["vec"] == [1.0, None, 2.0] and "inf" in rec["vec_repr"]
+    assert rec["good_vec"] == [1.0, 2.0] and "good_vec_repr" not in rec
+    assert rec["nested"]["deep"] is None
+    json.dumps(rec, allow_nan=False)  # must not raise
+
+
+def test_metrics_writer_nan_roundtrips_as_null(tmp_path):
+    """A NaN scalar must land as standard JSON (null + ``<key>_repr``),
+    not the bare ``NaN`` token that breaks every compliant parser —
+    round-tripped through json.loads to prove it."""
+    from pytorch_ddp_template_tpu.train.metrics import MetricsWriter
+
+    w = MetricsWriter(tmp_path)
+    w.write(7, {"loss": float("nan"), "grad_norm": 1.25})
+    w.close()
+    raw = (tmp_path / "metrics.jsonl").read_text()
+    assert "NaN" not in raw  # the non-standard token never appears
+    row = json.loads(raw.splitlines()[0])
+    assert row["step"] == 7
+    assert row["loss"] is None and row["loss_repr"] == "nan"
+    assert row["grad_norm"] == 1.25
+
+
+def test_metrics_writer_vector_channel(tmp_path):
+    """Flat lists (the per-layer health vector) are a JSONL-only channel;
+    non-finite elements sanitise element-wise."""
+    from pytorch_ddp_template_tpu.train.metrics import MetricsWriter
+
+    w = MetricsWriter(tmp_path)
+    w.write(3, {"per_layer_grad_norm": [0.5, float("inf"), 2.0]})
+    w.close()
+    row = json.loads((tmp_path / "metrics.jsonl").read_text().splitlines()[0])
+    assert row["per_layer_grad_norm"] == [0.5, None, 2.0]
+    assert "inf" in row["per_layer_grad_norm_repr"]
+
+
+def test_telemetry_fetch_handles_vectors(tmp_path):
+    """The drain-side host conversion must pass device VECTORS through as
+    lists (scalars stay floats; windows still mean)."""
+    from pytorch_ddp_template_tpu.train.metrics import _to_host
+
+    host = _to_host({
+        "vec": jnp.asarray([1.0, 2.0, 3.0]),
+        "scalar": jnp.float32(4.0),
+        "window": [jnp.float32(1.0), jnp.float32(3.0)],
+    })
+    assert host["vec"] == [1.0, 2.0, 3.0]
+    assert host["scalar"] == 4.0
+    assert host["window"] == 2.0
+
+
+# -- in-step health pack ---------------------------------------------------
+
+def test_health_metrics_norms_and_counts():
+    params = {"w": jnp.full((4, 4), 2.0), "b": jnp.zeros(4)}
+    updates = {"w": jnp.full((4, 4), 0.02), "b": jnp.zeros(4)}
+    grads = {"w": jnp.ones((4, 4)).at[0, 0].set(jnp.nan),
+             "b": jnp.array([1.0, jnp.inf, 0.0, 0.0])}
+    h = health_metrics(loss=jnp.float32(jnp.nan), grads=grads,
+                       params=params, updates=updates)
+    assert float(h["param_norm"]) == pytest.approx(8.0)
+    assert float(h["update_ratio"]) == pytest.approx(0.01)
+    assert int(h["nonfinite_loss"]) == 1
+    assert int(h["nonfinite_grads"]) == 2
+    assert "per_layer_grad_norm" not in h  # no scanned stack in the tree
+    assert "ef_residual_norm" not in h
+
+
+def test_health_metrics_per_layer_vector_from_stacked_tree():
+    """Under --scan_layers the stacked (L, ...) grads reduce to ONE (L,)
+    vector — per-layer norms at the cost of a fused reduction."""
+    L = 3
+    grads = {"encoder": {"layers": {
+        "fc": {"kernel": jnp.stack([jnp.full((2, 2), float(i + 1))
+                                    for i in range(L)])},
+        "ln": {"scale": jnp.stack([jnp.full((2,), float(i + 1))
+                                   for i in range(L)])},
+    }}, "head": {"kernel": jnp.ones((2, 2))}}
+    params = jax.tree.map(jnp.ones_like, grads)
+    h = health_metrics(loss=jnp.float32(1.0), grads=grads, params=params,
+                       updates=jax.tree.map(jnp.zeros_like, params))
+    per = np.asarray(h["per_layer_grad_norm"])
+    assert per.shape == (L,)
+    # layer i: kernel 4 elements of (i+1)^2 + scale 2 elements of (i+1)^2
+    expect = [math.sqrt(6 * (i + 1) ** 2) for i in range(L)]
+    np.testing.assert_allclose(per, expect, rtol=1e-6)
+    assert int(h["nonfinite_grads"]) == 0
+
+
+def test_health_metrics_ef_residual_norm():
+    res = {"stack": jnp.full((2, 4), 3.0)}
+    h = health_metrics(loss=jnp.float32(1.0), grads={"w": jnp.ones(2)},
+                       params={"w": jnp.ones(2)},
+                       updates={"w": jnp.zeros(2)}, residual=res)
+    assert float(h["ef_residual_norm"]) == pytest.approx(
+        math.sqrt(8 * 9.0))
+
+
+def test_train_step_emits_health_pack(tmp_path):
+    """The production step metrics carry the health keys when
+    --health_pack is on (the default) and stay bit-stable without."""
+    t = make_trainer(tmp_path / "on")
+    state, _ = t.restore_or_init()
+    batch = next(iter(t.loader.epoch(0)))
+    _, metrics = t.train_step(state, batch)
+    for k in ("param_norm", "update_ratio", "nonfinite_loss",
+              "nonfinite_grads"):
+        assert k in metrics, k
+    assert int(metrics["nonfinite_loss"]) == 0
+    t_off = make_trainer(tmp_path / "off", health_pack=False)
+    state_off, _ = t_off.restore_or_init()
+    batch_off = next(iter(t_off.loader.epoch(0)))
+    _, metrics_off = t_off.train_step(state_off, batch_off)
+    assert not any(k in metrics_off for k in HEALTH_KEYS)
+
+
+# -- anomaly sentry --------------------------------------------------------
+
+def steady(sentry, n, *, loss=1.0, start=0):
+    for i in range(n):
+        sentry.observe(start + i, {"loss": loss, "grad_norm": 0.5,
+                                   "nonfinite_loss": 0.0,
+                                   "nonfinite_grads": 0.0})
+
+
+def test_sentry_rejects_unknown_mode():
+    with pytest.raises(ValueError, match="anomaly"):
+        AnomalySentry("typo")
+
+
+def test_sentry_nonfinite_triggers_immediately():
+    s = AnomalySentry("warn")
+    s.observe(0, {"loss": float("nan"), "grad_norm": 1.0})
+    trig = s.poll_trigger()
+    assert trig is not None and trig["step"] == 0
+    assert any("non-finite" in r for r in trig["reasons"])
+    assert s.poll_trigger() is None  # delivered exactly once
+
+
+def test_sentry_nonfinite_counter_triggers():
+    s = AnomalySentry("halt")
+    s.observe(4, {"loss": 1.0, "grad_norm": 1.0, "nonfinite_grads": 3.0})
+    trig = s.poll_trigger()
+    assert trig is not None and "nonfinite_grads=3" in trig["reasons"][0]
+
+
+def test_sentry_spike_needs_history_then_fires():
+    s = AnomalySentry("warn", threshold=10.0, min_history=16)
+    # a spike BEFORE min_history finite samples: no trigger (cold start)
+    s.observe(0, {"loss": 100.0, "grad_norm": 0.5})
+    assert not s.triggered
+    steady(s, 32, start=1)
+    assert not s.triggered  # the early outlier aged out of the window
+    s.observe(50, {"loss": 50.0, "grad_norm": 0.5})
+    trig = s.poll_trigger()
+    assert trig is not None
+    assert any("loss spike" in r for r in trig["reasons"])
+
+
+def test_sentry_steady_and_drifting_stream_no_trigger():
+    s = AnomalySentry("warn", threshold=10.0, min_history=16)
+    # smooth exponential-ish decay — the normal shape of a healthy loss
+    for i in range(200):
+        s.observe(i, {"loss": 2.0 * (0.99 ** i) + 0.5,
+                      "grad_norm": 1.0 - i * 1e-3})
+    assert not s.triggered
+
+
+def test_sentry_ring_eviction_and_snapshot():
+    s = AnomalySentry("warn", window=8)
+    steady(s, 20)
+    recs = s.records()
+    assert len(recs) == 8
+    assert [r["step"] for r in recs] == list(range(12, 20))
+    assert recs[0]["loss"] == 1.0
+
+
+# -- flight recorder -------------------------------------------------------
+
+def test_flight_recorder_bundle_complete_and_parseable(tmp_path):
+    from pytorch_ddp_template_tpu.config import TrainingConfig
+
+    rec = FlightRecorder(tmp_path)
+    ring = [{"step": i, "loss": 1.0} for i in range(4)]
+    ring.append({"step": 4, "loss": float("nan")})
+    d = rec.dump(step=4, trigger={"step": 4, "reasons": ["loss non-finite"],
+                                  "scalars": {"loss": float("nan")}},
+                 ring=ring, config=TrainingConfig(),
+                 describe_snapshot={"mesh": {"data": 8}},
+                 fingerprint=[1.0, float("nan")])
+    assert d.parent == tmp_path / "flight_records"
+    names = {p.name for p in d.iterdir()}
+    assert set(BUNDLE_FILES) <= names
+    # every artifact is STANDARD json (the bundle's raison d'être is
+    # non-finite values — they must not poison it)
+    trig = json.loads((d / "trigger.json").read_text())
+    assert trig["scalars"]["loss"] is None
+    assert trig["scalars"]["loss_repr"] == "nan"
+    rows = [json.loads(l) for l in (d / "ring.jsonl").read_text().splitlines()]
+    assert rows[-1]["loss"] is None and rows[-1]["loss_repr"] == "nan"
+    fp = json.loads((d / "fingerprint.json").read_text())
+    assert fp["fingerprint"] == [1.0, None]
+    assert json.loads((d / "config.json").read_text())["seed"] == 42
+    # a re-trigger at the same step gets its own directory
+    d2 = rec.dump(step=4, trigger={"step": 4, "reasons": ["again"]}, ring=[])
+    assert d2 != d and d2.name.startswith("step_00000004.")
+
+
+# -- engine integration ----------------------------------------------------
+
+def test_engine_crash_closes_trace_and_dumps(tmp_path):
+    """Satellite 3: an exception mid-loop must still stop the live
+    profiler capture (the crashed run's partial profile is the one you
+    want most) and give the flight recorder its chance to dump."""
+    t = make_trainer(tmp_path, max_steps=30, profile_steps=10,
+                     anomaly="warn")
+    calls = {"n": 0}
+    orig = t.train_step
+
+    def exploding(state, batch, *rest):
+        calls["n"] += 1
+        if calls["n"] == 13:  # inside the profile window [10, 20)
+            raise RuntimeError("injected step failure")
+        return orig(state, batch, *rest)
+
+    t.train_step = exploding
+    with pytest.raises(RuntimeError, match="injected step failure"):
+        t.train()
+    # the partially-captured trace was flushed, not lost
+    profile_dir = tmp_path / "profile"
+    assert profile_dir.exists()
+    assert any(profile_dir.rglob("*.xplane.pb")), list(profile_dir.rglob("*"))
+    # and the crash bundle exists with the exception named
+    bundles = sorted((tmp_path / "flight_records").glob("step_*"))
+    assert bundles, "crash must leave a flight record"
+    trig = json.loads((bundles[0] / "trigger.json").read_text())
+    assert trig["mode"] == "crash"
+    assert any("injected step failure" in r for r in trig["reasons"])
+    # telemetry sink was closed by train()'s finally despite the raise
+    assert t.telemetry._closed
+
+
+def test_anomaly_halt_end_to_end(tmp_path):
+    """A NaN'd loss mid-run: the sentry triggers off the drained health
+    feed, the flight recorder dumps a complete bundle (including the
+    post-trigger trace), and halt stops the run cleanly with a
+    checkpoint — the full production triage path."""
+    t = make_trainer(tmp_path, max_steps=40, logging_steps=5,
+                     save_steps=0, anomaly="halt")
+    calls = {"n": 0}
+    orig = t.train_step
+
+    def poisoned(state, batch, *rest):
+        state, m = orig(state, batch, *rest)
+        calls["n"] += 1
+        if calls["n"] == 8:
+            m = dict(m)
+            m["loss"] = m["loss"] * jnp.float32(float("nan"))
+        return state, m
+
+    t.train_step = poisoned
+    state = t.train()
+    assert int(state.step) < 40, "halt must stop the run early"
+    assert t.ckpt.latest_step() == int(state.step)  # clean resume point
+    bundles = sorted((tmp_path / "flight_records").glob("step_*"))
+    assert len(bundles) == 1
+    names = {p.name for p in bundles[0].iterdir()}
+    assert set(BUNDLE_FILES) <= names
+    assert "profile" in names  # the post-trigger TraceWindow capture
+    ring = [json.loads(l)
+            for l in (bundles[0] / "ring.jsonl").read_text().splitlines()]
+    assert ring, "ring buffer must hold the pre-trigger history"
+    # the poisoned step is in the ring, sanitised (healthy steps drained
+    # after the trigger may follow it — the dump happens on the loop
+    # thread one poll later)
+    assert any(r["loss"] is None and r.get("loss_repr") == "nan"
+               for r in ring)
+    # the NaN also flowed through the logging-boundary progress record
+    # as standard JSON
+    raw = (tmp_path / "metrics.jsonl").read_text()
+    assert "NaN" not in raw
+
+
+def test_warn_trigger_inside_profile_window_survives(tmp_path):
+    """A trigger whose 4-step flight capture would collide with the
+    --profile_steps window must SKIP the flight trace (one live profiler
+    trace per process), not raise 'Profile has already been started' and
+    kill a run that warn mode promises never to cost."""
+    t = make_trainer(tmp_path, max_steps=24, profile_steps=10,
+                     anomaly="warn")
+    calls = {"n": 0}
+    orig = t.train_step
+
+    def poisoned(state, batch, *rest):
+        state, m = orig(state, batch, *rest)
+        calls["n"] += 1
+        if calls["n"] == 7:  # flight window [~8, ~12) overlaps [10, 20)
+            m = dict(m)
+            m["loss"] = m["loss"] * jnp.float32(float("nan"))
+        return state, m
+
+    t.train_step = poisoned
+    state = t.train()  # must complete, not crash at the window boundary
+    assert int(state.step) == 24
+    bundles = sorted((tmp_path / "flight_records").glob("step_*"))
+    assert bundles, "the bundle still dumps; only the trace is skipped"
+    assert not (bundles[0] / "profile").exists()
+    # the user's requested profile window still captured
+    assert any((tmp_path / "profile").rglob("*.xplane.pb"))
+
+
+def test_hlo_report_writes_json_and_logs(tmp_path):
+    """--hlo_report compiles the step ahead of the loop and leaves the
+    schedule report on disk; a plain data-parallel run has no overlap
+    flags, so zero tripwire warnings."""
+    t = make_trainer(tmp_path, max_steps=2, hlo_report=True)
+    state = t.train()
+    assert int(state.step) == 2
+    rep = json.loads((tmp_path / "hlo_report.json").read_text())
+    for k in ("ops", "wire_mb_estimate", "gather", "ring", "composed",
+              "warnings", "compile_s"):
+        assert k in rep, k
+    assert rep["warnings"] == []
+
+
+# -- HLO schedule report (text-level) --------------------------------------
+
+# hand-written HLO with one dot-carrying loop body whose all-gather is
+# compute-INDEPENDENT (operand %w is loop-carried) and whose all-reduce is
+# compute-DEPENDENT (operand %d is this body's dot) — the r8 signature
+_HLO_OVERLAPPED = """\
+HloModule synthetic
+
+%body (p: (s32[], f32[8,8])) -> (s32[], f32[8,8]) {
+  %p = (s32[], f32[8,8]{1,0}) parameter(0)
+  %i = s32[] get-tuple-element(%p), index=0
+  %w = f32[8,8]{1,0} get-tuple-element(%p), index=1
+  %g = f32[8,8]{1,0} all-gather(%w), replica_groups={{0,1}}
+  %d = f32[8,8]{1,0} dot(%g, %w), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %r = f32[8,8]{1,0} all-reduce(%d), to_apply=%sum
+  ROOT %t = (s32[], f32[8,8]{1,0}) tuple(%i, %r)
+}
+
+ENTRY %main (x: f32[8,8]) -> f32[8,8] {
+  ROOT %out = f32[8,8]{1,0} copy(%x)
+}
+"""
+
+# the de-overlapped twin: the gather consumes the dot — no schedulable
+# freedom anywhere; likewise the ring body's ppermute
+_HLO_SERIAL = """\
+HloModule synthetic_serial
+
+%body (p: (s32[], f32[8,8])) -> (s32[], f32[8,8]) {
+  %p = (s32[], f32[8,8]{1,0}) parameter(0)
+  %i = s32[] get-tuple-element(%p), index=0
+  %w = f32[8,8]{1,0} get-tuple-element(%p), index=1
+  %d = f32[8,8]{1,0} dot(%w, %w), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %g = f32[8,8]{1,0} all-gather(%d), replica_groups={{0,1}}
+  ROOT %t = (s32[], f32[8,8]{1,0}) tuple(%i, %g)
+}
+
+%ring (q: (s32[], f32[4,4])) -> (s32[], f32[4,4]) {
+  %q = (s32[], f32[4,4]{1,0}) parameter(0)
+  %j = s32[] get-tuple-element(%q), index=0
+  %v = f32[4,4]{1,0} get-tuple-element(%q), index=1
+  %d2 = f32[4,4]{1,0} dot(%v, %v), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %cp = f32[4,4]{1,0} collective-permute(%d2), source_target_pairs={{0,1},{1,0}}
+  ROOT %t2 = (s32[], f32[4,4]{1,0}) tuple(%j, %cp)
+}
+
+ENTRY %main (x: f32[8,8]) -> f32[8,8] {
+  ROOT %out = f32[8,8]{1,0} copy(%x)
+}
+"""
+
+
+def test_collective_evidence_classifies_synthetic_bodies():
+    ev = collective_evidence(_HLO_OVERLAPPED)
+    assert len(ev["bodies"]) == 1
+    body = ev["bodies"][0]
+    assert body["dots"] == 1 and body["collectives"] == 2
+    assert body["compute_independent_collectives"] == 1
+    assert body["compute_dependent_collectives"] == 1
+    assert ev["prefetch_gather_independent"] is True
+    serial = collective_evidence(_HLO_SERIAL)
+    assert all(r["compute_independent_collectives"] == 0
+               for r in serial["bodies"])
+    assert serial["prefetch_gather_independent"] is False
+
+
+def test_ring_evidence_counts_clean_bodies():
+    ev = ring_evidence(_HLO_SERIAL)
+    assert ev["ring_bodies"] == 1  # the %ring body carries a ppermute
+    assert ev["independent_ring_bodies"] == 0  # but it consumes the dot
+
+
+def test_op_census_counts_and_wire_bytes():
+    census = op_census(_HLO_OVERLAPPED)
+    assert census["all-gather"]["count"] == 1
+    assert census["all-gather"]["wire_bytes"] == 8 * 8 * 4
+    assert census["all-reduce"]["count"] == 1
+
+
+def test_schedule_report_shape():
+    rep = schedule_report(_HLO_OVERLAPPED)
+    assert rep["gather"]["independent_bodies"] == 1
+    assert rep["gather"]["dependent_collectives"] == 1
+    assert rep["ring"]["ring_bodies"] == 0
+    assert rep["wire_mb_estimate"] >= 0
+
+
+def test_tripwire_flags_de_overlapped_config():
+    """The acceptance tripwire: a config CLAIMING overlap whose compiled
+    program shows no schedulable freedom must WARN — per axis, with the
+    reason named."""
+    from pytorch_ddp_template_tpu.config import TrainingConfig
+
+    cfg = TrainingConfig(scan_layers=True, fsdp_overlap=True,
+                         tp_overlap=True, mesh="data:2,model:2")
+    rep = schedule_report(_HLO_SERIAL)
+    warns = check_overlap_expectations(rep, cfg,
+                                       {"data": 2, "model": 2})
+    assert any("--fsdp_overlap" in w for w in warns)
+    assert any("--tp_overlap" in w for w in warns)
+    # degenerate axes are NOT degraded schedules: no collectives compile
+    # at size 1, so the tripwire stays silent
+    assert check_overlap_expectations(rep, cfg,
+                                      {"data": 1, "model": 1}) == []
+    # and a healthy overlapped program passes the fsdp check
+    ok = schedule_report(_HLO_OVERLAPPED)
+    warns_ok = check_overlap_expectations(
+        ok, TrainingConfig(scan_layers=True, fsdp_overlap=True),
+        {"data": 2})
+    assert warns_ok == []
+
+
+def test_ddp_tripwire_wants_inscan_reduce():
+    from pytorch_ddp_template_tpu.config import TrainingConfig
+
+    cfg = TrainingConfig(scan_layers=True, ddp_overlap=True)
+    # _HLO_SERIAL's gather body still has an in-body reduce → no warning
+    assert check_overlap_expectations(
+        schedule_report(_HLO_SERIAL), cfg, {"data": 2}) == []
+    # a program with NO collective in any dot-carrying body → warning
+    no_coll = _HLO_SERIAL.replace(
+        "  %g = f32[8,8]{1,0} all-gather(%d), replica_groups={{0,1}}\n", ""
+    ).replace("tuple(%i, %g)", "tuple(%i, %d)")
+    warns = check_overlap_expectations(
+        schedule_report(no_coll), cfg, {"data": 2})
+    assert any("--ddp_overlap" in w for w in warns)
+
+
+@pytest.mark.slow
+def test_hlo_report_matches_composed_evidence_on_real_schedule(devices):
+    """Acceptance: --hlo_report's counts on the composed fsdp×tp schedule
+    must equal the r11 ``hlo_composed_evidence`` leg's (same walkers, one
+    home), report zero tripwire warnings for the genuinely-composed
+    program — and flag the SAME geometry compiled WITHOUT the overlap
+    execution (the deliberately de-overlapped configuration)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pytorch_ddp_template_tpu.config import TrainingConfig
+    from pytorch_ddp_template_tpu.models.gpt import CausalLmTask, GptDecoder
+    from pytorch_ddp_template_tpu.parallel.schedule import (
+        hlo_composed_evidence,
+    )
+    from pytorch_ddp_template_tpu.parallel.sharding import (
+        fsdp_reshard, shard_tree,
+    )
+    from pytorch_ddp_template_tpu.runtime import make_mesh
+    from pytorch_ddp_template_tpu.train.engine import (
+        TrainState, make_optimizer, make_train_step,
+    )
+
+    mesh = make_mesh("data:4,model:2", jax.devices())
+    vocab, seq, depth = 512, 32, 2
+    ids = np.random.default_rng(0).integers(0, vocab, (8, seq))
+    batch = {"input_ids": jax.device_put(
+        np.asarray(ids, np.int32), NamedSharding(mesh, P("data")))}
+    key = jax.random.PRNGKey(0)
+    cfg = TrainingConfig(warmup_steps=0, max_grad_norm=1000.0)
+    tx, sched = make_optimizer(cfg, total_steps=100)
+
+    def compiled_text(composed: bool):
+        model = GptDecoder(
+            vocab_size=vocab, max_len=seq, num_layers=depth, num_heads=4,
+            head_dim=8, mlp_dim=64, scan_layers=True, fused_head=True,
+            fsdp_overlap=composed, tp_overlap=composed,
+            mesh=mesh if composed else None)
+        task = CausalLmTask(model)
+        params, extra = task.init(key, batch)
+        state = TrainState(
+            step=jnp.zeros((), jnp.int32), params=params, extra_vars=extra,
+            opt_state=tx.init(params), rng=jax.random.clone(key))
+        state = shard_tree(state, mesh)
+        if composed:
+            state = state.replace(
+                params=fsdp_reshard(state.params, mesh, prefer_dim=0),
+                opt_state=fsdp_reshard(state.opt_state, mesh, prefer_dim=0))
+        return make_train_step(task, tx, sched).lower(
+            state, batch).compile().as_text()
+
+    claim = TrainingConfig(scan_layers=True, fsdp_overlap=True,
+                           tp_overlap=True, mesh="data:4,model:2")
+    text = compiled_text(composed=True)
+    ev = hlo_composed_evidence(text)
+    rep = schedule_report(text)
+    assert (rep["composed"]["independent_gather_bodies"]
+            == ev["independent_gather_bodies"] > 0)
+    assert (rep["composed"]["independent_ring_bodies"]
+            == ev["independent_ring_bodies"] > 0)
+    assert rep["composed"]["composed_overlap_independent"] is True
+    assert check_overlap_expectations(rep, claim, dict(mesh.shape)) == []
+
+    # the de-overlapped configuration: same claim, GSPMD-default program
+    rep_off = schedule_report(compiled_text(composed=False))
+    warns = check_overlap_expectations(rep_off, claim, dict(mesh.shape))
+    assert warns, "the tripwire must flag the de-overlapped schedule"
+    assert any("--tp_overlap" in w for w in warns)
