@@ -41,20 +41,16 @@ BASELINE_PER_DEVICE = {
     "mlp-wide": ("mlp_wide_examples_per_sec_per_chip", "examples/sec/chip", 1.0e6),
 }
 
-# Peak dense-matmul throughput per chip (bf16), for MFU. Sources: public
-# TPU spec sheets; GPU entries cover dev boxes so MFU stays meaningful.
-PEAK_FLOPS = {
-    "TPU v6e": 918e12,  # Trillium
-    "TPU v6 lite": 918e12,
-    "TPU v5p": 459e12,
-    "TPU v5e": 197e12,
-    "TPU v5 lite": 197e12,
-    "TPU v4": 275e12,
-    "TPU v3": 123e12,
-    "TPU v2": 45e12,
-}
+# Peak dense-matmul throughput per chip (bf16), for MFU. The table and
+# the cost-analysis helper live in obs/attribution.py since r13 (the
+# production loop consumes them under --perf_report); bench.py and
+# tools/mfu_probe.py import THE one copy. Stdlib-only import chain —
+# safe before init_devices().
+from pytorch_ddp_template_tpu.obs.attribution import (  # noqa: E402
+    PEAK_FLOPS, cost_of,
+)
 
-MODE = os.environ.get("BENCH_MODE", "train")  # train | e2e | scaling | flash | compile | overlap | comms | tp | overlap3d | obs
+MODE = os.environ.get("BENCH_MODE", "train")  # train | e2e | scaling | flash | compile | overlap | comms | tp | overlap3d | obs | perf
 MODEL = os.environ.get("BENCH_MODEL", "resnet50")
 WARMUP_STEPS = int(os.environ.get("BENCH_WARMUP", "5"))
 TIMED_STEPS = int(os.environ.get("BENCH_STEPS", "30"))
@@ -244,22 +240,6 @@ def init_devices(max_tries: int = 6, delay_s: float = 10.0):
                 time.sleep(delay_s)
                 delay_s *= 1.5
     raise last  # type: ignore[misc]
-
-
-def cost_of(compiled) -> dict:
-    """FLOPs + bytes of one executable from XLA's own cost analysis
-    (zeros when the backend exposes none — cost analysis is best-effort).
-    Shared with tools/mfu_probe.py."""
-    try:
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0] if cost else {}
-        return {
-            "flops": float(cost.get("flops", 0.0)),
-            "bytes": float(cost.get("bytes accessed", 0.0)),
-        }
-    except Exception:  # noqa: BLE001
-        return {"flops": 0.0, "bytes": 0.0}
 
 
 def _flops_of(compiled) -> float | None:
@@ -1841,6 +1821,202 @@ def run_obs() -> dict:
     }
 
 
+def run_perf() -> dict:
+    """Performance-attribution proof (round 13, ``obs/attribution.py`` +
+    ``obs/goodput.py``): the step-time X-ray must be ~free when on and
+    arithmetically honest in what it reports.
+
+    Legs, sized for what THIS host can prove (real-MFU numbers ride
+    tools/tpu_followup_r13.sh):
+
+    - **neutrality**: the FULL production loop (``Trainer.train()`` —
+      annotations, goodput accounting, perf snapshots at the logging
+      cadence) with ``--perf_report`` + phase annotations ON vs both
+      OFF, same model/batch/mesh, alternating fresh-trainer reps with
+      min-of-reps steady-state step time (r11/r12 convention against
+      ambient load). ``value`` = plain/perf step-time ratio; the 0.9
+      band carries the headline.
+    - **MFU sanity**: a production run with a peak chosen by priority —
+      BENCH_PEAK_TFLOPS, else the PEAK_FLOPS spec table (real hardware:
+      the reported MFU is the TRUE one, comparable with
+      tools/mfu_probe.py), else calibration at 4x the achieved rate
+      (CPU only — PEAK_FLOPS has no CPU entry BY DESIGN, and the
+      calibration pins the expectation near 0.25). The leg then
+      re-derives MFU from the cost model's FLOPs over the run's
+      INDEPENDENT ``StepTimer`` mean step time and asserts the two
+      agree (``mfu_consistent``) — the pipeline from cost_analysis
+      through the attribution's interval walls is self-consistent, and
+      MFU is in (0, 1].
+    - **attribution + goodput**: the same run's fractional breakdown
+      must sum to ~1.0, and ``goodput.json`` must exist with the full
+      bucket set.
+
+    Knobs: BENCH_MODEL (default mlp-wide — device-bound steps),
+    BENCH_BATCH, BENCH_STEPS/BENCH_WARMUP, BENCH_LOG_STEPS,
+    BENCH_PEAK_TFLOPS (skip the calibration), BENCH_OUTPUT.
+    """
+    import jax
+
+    from pytorch_ddp_template_tpu.config import TrainingConfig
+    from pytorch_ddp_template_tpu.models import build
+    from pytorch_ddp_template_tpu.runtime import init as rt_init
+    from pytorch_ddp_template_tpu.train.engine import Trainer
+    from pytorch_ddp_template_tpu.utils.profiler import set_phase_annotations
+
+    model = os.environ.get("BENCH_MODEL") or "mlp-wide"
+    per_device = PER_DEVICE_BATCH or default_batch(model)
+    n_dev = len(jax.devices())
+    global_batch = per_device * n_dev
+    out_base = os.environ.get("BENCH_OUTPUT", "/tmp/bench_perf")
+    log_steps = int(os.environ.get("BENCH_LOG_STEPS", "5"))
+    total_steps = WARMUP_STEPS + TIMED_STEPS
+
+    base_cfg = dict(
+        model=model, mesh=f"data:{n_dev}",
+        per_device_train_batch_size=per_device, bf16=True,
+        dataset_size=max(global_batch * (total_steps + 2), 512),
+        warmup_steps=0, max_grad_norm=1000.0, max_steps=total_steps,
+        logging_steps=log_steps, save_steps=0, resume=False,
+    )
+    ctx = rt_init(TrainingConfig(**base_cfg, output_dir=out_base + "_init"))
+
+    def run_variant(kind: str, rep: int, peak_tflops: float = 0.0):
+        """One full production-loop run; returns the finished Trainer."""
+        perf = kind == "perf"
+        set_phase_annotations(perf)
+        try:
+            cfg = TrainingConfig(**{
+                **base_cfg, "perf_report": perf,
+                "peak_tflops": peak_tflops,
+                "output_dir": f"{out_base}_{kind}_{rep}"})
+            import shutil
+
+            shutil.rmtree(cfg.output_dir, ignore_errors=True)
+            task, ds = build(model, cfg, mesh=ctx.mesh)
+            trainer = Trainer(cfg, ctx, task, ds)
+            trainer.train()
+            return trainer
+        finally:
+            set_phase_annotations(True)
+
+    # -- neutrality leg: alternating fresh-run reps, min-of-reps ----------
+    step_ms: dict[str, float] = {}
+    flops_per_step = 0.0
+    for rep in range(3):
+        for kind in ("plain", "perf"):
+            trainer = run_variant(kind, rep)
+            ms = trainer.step_timer.summary().get("step_time_mean_ms")
+            if ms is None:
+                raise RuntimeError("timed window produced no step samples")
+            step_ms[kind] = min(step_ms.get(kind, ms), ms)
+            if kind == "perf" and trainer.perf is not None:
+                flops_per_step = trainer.perf.cost_model["flops_per_step"]
+    ratio = step_ms["plain"] / max(step_ms["perf"], 1e-9)
+    if flops_per_step <= 0:
+        # cost analysis is best-effort (cost_of returns zeros when the
+        # backend exposes none): without FLOPs there is no MFU to sanity-
+        # check on ANY peak source — fail here with the true cause, not
+        # after the sanity run with a misleading missing-records error
+        raise RuntimeError(
+            "cost analysis reported no FLOPs for the compiled step; the "
+            "MFU-sanity leg cannot run (backend cost_analysis "
+            "unavailable for this executable)")
+
+    # -- MFU-sanity leg ---------------------------------------------------
+    # peak priority: explicit BENCH_PEAK_TFLOPS > the PEAK_FLOPS spec
+    # table (real hardware: the reported MFU is the TRUE one, directly
+    # comparable with tools/mfu_probe.py) > calibration at 4x the
+    # achieved rate (CPU hosts only — pins the expectation near 0.25 so
+    # the leg proves pipeline consistency, never a hardware number)
+    from pytorch_ddp_template_tpu.obs.attribution import peak_flops_for
+
+    peak_env = float(os.environ.get("BENCH_PEAK_TFLOPS", "0") or 0)
+    table_peak = peak_flops_for(jax.devices()[0].device_kind)
+    peak_calibrated = False
+    if peak_env > 0:
+        peak_per_chip_tflops = peak_env
+    elif table_peak is not None:
+        peak_per_chip_tflops = table_peak / 1e12
+    else:
+        achieved = flops_per_step / (step_ms["perf"] / 1e3)  # whole program
+        peak_per_chip_tflops = achieved * 4 / n_dev / 1e12
+        peak_calibrated = True
+    sanity = run_variant("perf", 99, peak_tflops=peak_per_chip_tflops)
+    sanity_step_ms = sanity.step_timer.summary()["step_time_mean_ms"]
+
+    from pathlib import Path
+
+    recs = [json.loads(l) for l in
+            (Path(f"{out_base}_perf_99") / "metrics.jsonl")
+            .read_text().splitlines() if l.strip()]
+    perf_recs = [r for r in recs if "perf_mfu" in r]
+    if not perf_recs:
+        raise RuntimeError("no perf attribution records in metrics.jsonl")
+    last = perf_recs[-1]
+    # steady-state reported MFU: mean over the attribution records,
+    # excluding the first interval (it contains the startup compile by
+    # construction — honestly low MFU, but not the steady state this
+    # consistency probe is about)
+    steady = perf_recs[1:] or perf_recs
+    mfu_reported = sum(r["perf_mfu"] for r in steady) / len(steady)
+    # cross-check against an INDEPENDENT measure of the same quantity:
+    # the StepTimer's steady per-iteration mean is the FLOPs-matched
+    # step time, so flops / (timer_mean * peak) must agree with what
+    # the attribution reported from its own interval walls
+    peak_total = peak_per_chip_tflops * 1e12 * n_dev
+    mfu_expected = flops_per_step / (sanity_step_ms / 1e3) / peak_total
+    mfu_consistent = (0.0 < mfu_reported <= 1.0 and mfu_expected > 0
+                      and abs(mfu_reported / mfu_expected - 1.0) <= 0.35)
+    frac_sum = (last["perf_frac_compute"] + last["perf_frac_comm"]
+                + last["perf_frac_host"] + last["perf_frac_input"])
+
+    gp_path = Path(f"{out_base}_perf_99") / "goodput.json"
+    goodput_rec = json.loads(gp_path.read_text()) if gp_path.is_file() else {}
+    from pytorch_ddp_template_tpu.obs.goodput import BUCKETS
+
+    goodput_complete = bool(goodput_rec) and all(
+        b in goodput_rec.get("buckets", {}) for b in BUCKETS)
+
+    return {
+        "metric": "perf_attribution_overhead_ratio",
+        "value": round(ratio, 3),
+        # perf_report + annotations vs both off, full production loop;
+        # the 0.9 band carries the headline (>= 0.9 = at most ~11% cost)
+        "unit": "x_plain_step_time",
+        "vs_baseline": round(ratio / 0.9, 4),
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "n_devices": n_dev,
+        "model": model,
+        "global_batch": global_batch,
+        "timed_steps": TIMED_STEPS,
+        "logging_steps": log_steps,
+        "step_time_plain_ms": round(step_ms["plain"], 3),
+        "step_time_perf_ms": round(step_ms["perf"], 3),
+        # MFU-sanity leg (CPU: calibrated peak — a pipeline-consistency
+        # proof, NOT a hardware MFU; the r13 followup records the real one)
+        "peak_tflops_per_chip": round(peak_per_chip_tflops, 6),
+        "peak_calibrated": peak_calibrated,
+        "model_gflops_per_step": round(flops_per_step / 1e9, 3),
+        "sanity_step_time_ms": round(sanity_step_ms, 3),
+        "mfu_reported": round(mfu_reported, 4),
+        "mfu_expected": round(mfu_expected, 4),
+        "mfu_consistent": bool(mfu_consistent),
+        # attribution fractions from the same record: must sum to ~1
+        "frac_compute": last["perf_frac_compute"],
+        "frac_comm": last["perf_frac_comm"],
+        "frac_host": last["perf_frac_host"],
+        "frac_input": last["perf_frac_input"],
+        "frac_sum": round(frac_sum, 4),
+        # goodput ledger: file written, every bucket present
+        "goodput_file_complete": goodput_complete,
+        "goodput": goodput_rec.get("goodput"),
+        "goodput_buckets_s": {
+            k: round(v, 3)
+            for k, v in goodput_rec.get("buckets", {}).items()},
+    }
+
+
 def run_scaling(model: str) -> dict:
     """DDP scaling sweep: per-chip throughput on data:1/2/4/... sub-meshes.
 
@@ -2040,6 +2216,8 @@ def main() -> None:
             _emit(run_overlap3d())
         elif MODE == "obs":
             _emit(run_obs())
+        elif MODE == "perf":
+            _emit(run_perf())
         elif MODE == "e2e":
             _emit(run_e2e(model, metric, unit, baseline))
         elif MODE == "train":
@@ -2048,7 +2226,7 @@ def main() -> None:
             raise ValueError(
                 f"unknown BENCH_MODE {MODE!r}; expected "
                 "train|e2e|scaling|flash|compile|overlap|comms|tp|"
-                "overlap3d|obs"
+                "overlap3d|obs|perf"
             )
     except KeyboardInterrupt:  # operator abort is not a value-0 datum
         raise

@@ -176,6 +176,31 @@ class TrainingConfig:
     anomaly_threshold: float = 10.0  # spike trigger at
     #                                  |x - median| > threshold * scale,
     #                                  scale = max(1.4826*MAD, 5%|median|)
+    perf_report: bool = False  # performance-attribution subsystem
+    #                            (obs/attribution.py): AOT-compile the
+    #                            step at startup (shared with
+    #                            --hlo_report when both are on), derive
+    #                            the static cost model (model FLOPs/step
+    #                            + HBM bytes/step from cost_analysis,
+    #                            collective wire bytes/step per mesh
+    #                            axis from the op census) and emit
+    #                            rolling MFU, achieved HBM/wire GB/s and
+    #                            the compute/comm/host/input fractional
+    #                            breakdown into the progress records.
+    #                            Costs one extra AOT compilation at
+    #                            startup — opt-in like --hlo_report.
+    #                            The goodput ledger (obs/goodput.py)
+    #                            runs regardless: it is host-side float
+    #                            adds + one JSON write per interval
+    perf_every: int = 0  # cadence (steps) of the perf-attribution
+    #                      records and goodput.json flushes; 0 = ride
+    #                      the --logging_steps cadence (perf fields
+    #                      merge into the progress record)
+    peak_tflops: float = 0.0  # per-chip peak bf16 TFLOP/s override for
+    #                           MFU; 0 = use the obs/attribution.py
+    #                           PEAK_FLOPS spec table (required for
+    #                           hardware the table does not know — MFU
+    #                           is omitted rather than invented)
     hlo_report: bool = False  # compile the train step ahead of the loop
     #                           and write an HLO schedule report
     #                           (obs/hlo_report.py) to
@@ -242,6 +267,16 @@ class TrainingConfig:
                 "yet: the residual leaves are sized for replicated "
                 "full-width grads, but the ddp×tp drain reduces "
                 "model-sharded slices; drop one of the two"
+            )
+        if self.perf_every < 0:
+            raise ValueError(
+                f"--perf_every must be >= 0, got {self.perf_every} "
+                "(0 = ride the --logging_steps cadence)"
+            )
+        if self.peak_tflops < 0:
+            raise ValueError(
+                f"--peak_tflops must be >= 0, got {self.peak_tflops} "
+                "(0 = use the obs/attribution.py spec table)"
             )
         if self.anomaly not in ("off", "warn", "halt"):
             raise ValueError(
@@ -588,6 +623,27 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="Spike sensitivity in robust deviations: trigger "
                         "at |x - median| > threshold * max(1.4826*MAD, "
                         "5%% of |median|).")
+    p.add_argument("--perf_report", action="store_true",
+                   help="Performance attribution (obs/attribution.py): "
+                        "AOT-compile the step at startup (one compile, "
+                        "shared with --hlo_report), derive a static cost "
+                        "model (model FLOPs/step, HBM bytes/step, "
+                        "collective wire bytes/step per mesh axis) and "
+                        "emit rolling MFU, achieved HBM/wire GB/s and a "
+                        "compute/comm/host/input fractional breakdown "
+                        "(fractions sum to 1.0) into the progress "
+                        "records. The goodput ledger runs regardless of "
+                        "this flag.")
+    p.add_argument("--perf_every", type=int, default=0,
+                   help="Cadence in steps of the perf-attribution records "
+                        "and goodput.json flushes (0 = ride "
+                        "--logging_steps; perf fields then merge into "
+                        "the progress record).")
+    p.add_argument("--peak_tflops", type=float, default=0.0,
+                   help="Per-chip peak bf16 TFLOP/s override for MFU "
+                        "(0 = the obs/attribution.py spec table; on "
+                        "hardware the table does not know, MFU is "
+                        "omitted unless this is set).")
     p.add_argument("--hlo_report", action="store_true",
                    help="Compile the train step ahead of the loop and "
                         "write obs/hlo_report.py's schedule report to "

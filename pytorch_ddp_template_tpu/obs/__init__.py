@@ -1,6 +1,7 @@
-"""Observability subsystem (round 12): the training loop watching itself.
+"""Observability subsystem (rounds 12-13): the training loop watching
+itself.
 
-Three coordinated pieces (ISSUE 7):
+Five coordinated pieces:
 
 - :mod:`.health` — in-step device-side health scalars (param/update
   norms, non-finite counts, per-layer grad norms, EF-residual norm)
@@ -10,7 +11,15 @@ Three coordinated pieces (ISSUE 7):
   under ``<output_dir>/flight_records/``;
 - :mod:`.hlo_report` — the r8-r11 HLO overlap-evidence walkers factored
   out of bench-only code, plus the ``--hlo_report`` startup schedule
-  report and its overlap-regression tripwire.
+  report and its overlap-regression tripwire;
+- :mod:`.attribution` — the r13 step-time X-ray: static cost model
+  (FLOPs + wire bytes per step, per mesh axis) from the startup compile
+  and the runtime MFU / compute-comm-host-input attribution
+  (``--perf_report``);
+- :mod:`.goodput` — the wall-clock ledger bucketing every second of the
+  run (productive / compile / checkpoint / restore / input-stall /
+  halted), persisted to ``goodput.json`` and accumulated across
+  restarts.
 
 Import discipline: :mod:`.hlo_report` is pure stdlib and must STAY
 reachable without jax installed/imported (the ``parallel/`` delegates and
@@ -24,6 +33,16 @@ no-cycle reason.
 from typing import Any
 
 _EXPORTS = {
+    "attribution": (
+        "HBM_BYTES_PER_SEC",
+        "ICI_BYTES_PER_SEC",
+        "PEAK_FLOPS",
+        "PerfAttribution",
+        "cost_of",
+        "peak_flops_for",
+        "static_cost_model",
+    ),
+    "goodput": ("BUCKETS", "GoodputLedger"),
     "health": ("HEALTH_KEYS", "health_metrics"),
     "hlo_report": (
         "GATHER_FAMILY",

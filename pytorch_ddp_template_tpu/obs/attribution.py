@@ -1,0 +1,288 @@
+"""Performance attribution: where the step time and the wire budget go.
+
+The complementary question to the r12 flight recorder's "is this run
+healthy?" is "is this run *fast*, and if not, what is it spending its
+time on?" — the question the MFU convention (PaLM, Chowdhery et al.
+2022: model FLOPs per step over step wall-time over peak matmul
+throughput, *all* overheads included in the denominator) and
+Megatron-LM-style efficiency reporting answer continuously in the large
+production stacks. Before this module the pieces existed but never met:
+``compiled.cost_analysis()`` ran in exactly one bench.py leg, MFU only
+in the standalone ``tools/mfu_probe.py``, wire-byte estimates only in
+the r12 ``op_census``, and the loader's stall counters only as a raw
+``input_wait_ms``.
+
+Two halves:
+
+- :func:`static_cost_model` — derived ONCE at startup from the
+  AOT-compiled train step (riding the same compile ``--hlo_report``
+  pays): model FLOPs/step and HBM bytes/step from XLA's own cost
+  analysis, plus expected collective wire bytes/step from the
+  :func:`obs.hlo_report.op_census` shape walk, split per collective
+  family and attributed per mesh axis (gather family → ``data``: the
+  fsdp/ddp/zero collectives; ring family → ``model``: the decomposed-TP
+  ppermutes). This is the engine's *a-priori* budget for the active
+  overlap schedule.
+- :class:`PerfAttribution` — combines that budget with what the loop
+  actually measures per logging interval (wall time, step count, the
+  loader's ``consumer_wait_s``/``producer_idle_s``, the dispatch-depth
+  barrier's device-wait time) into rolling MFU, achieved HBM/wire
+  bytes-per-second estimates, and a compute/comm/host/input fractional
+  breakdown that sums to exactly 1.0.
+
+Attribution semantics (honest about what host-side wall-clock can and
+cannot prove): ``input`` is measured directly (the loop blocked on the
+loader), ``host`` is measured directly (iteration wall minus input minus
+the device-wait fence read), and the *device* remainder is split into
+``compute`` vs ``comm`` by the static model's estimated time ratio
+(FLOPs/peak vs wire-bytes/interconnect-bandwidth). Where no peak or
+bandwidth figure exists for the device (CPU hosts; ``--peak_tflops``
+overrides), the whole device share is reported as compute and MFU is
+omitted rather than invented. Achieved overlap shows up exactly as you
+want it to: hidden communication inflates no bucket, because the split
+only distributes time the loop *observably spent* waiting on the device.
+
+Import discipline: top-level imports are stdlib-only (like
+:mod:`obs.hlo_report`) so bench.py can pull :data:`PEAK_FLOPS` and
+:func:`cost_of` before any backend initialises.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .hlo_report import GATHER_FAMILY, RING_FAMILY, op_census
+
+#: Peak dense-matmul throughput per chip (bf16), for MFU. Sources: public
+#: TPU spec sheets; matched by substring against ``device.device_kind``.
+#: Moved here from bench.py (r13) — bench and tools/mfu_probe.py import
+#: this copy. No CPU entry on purpose: a made-up CPU "peak" would turn
+#: MFU into fiction; CPU runs pass ``--peak_tflops`` (the bench perf leg
+#: calibrates one) or simply report no MFU.
+PEAK_FLOPS = {
+    "TPU v6e": 918e12,  # Trillium
+    "TPU v6 lite": 918e12,
+    "TPU v5p": 459e12,
+    "TPU v5e": 197e12,
+    "TPU v5 lite": 197e12,
+    "TPU v4": 275e12,
+    "TPU v3": 123e12,
+    "TPU v2": 45e12,
+}
+
+#: Per-chip interconnect bandwidth (bytes/s, one direction, order-of-
+#: magnitude spec figures) for the comm-time estimate that splits the
+#: device share into compute vs comm. Coarse by design: the split is an
+#: attribution heuristic, not a measurement — the followup trace legs
+#: measure real overlap.
+ICI_BYTES_PER_SEC = {
+    "TPU v6e": 3584e9 / 2,
+    "TPU v6 lite": 3584e9 / 2,
+    "TPU v5p": 4800e9 / 2,
+    "TPU v5e": 1600e9 / 2,
+    "TPU v5 lite": 1600e9 / 2,
+    "TPU v4": 2400e9 / 2,
+    "TPU v3": 700e9 / 2,
+    "TPU v2": 500e9 / 2,
+}
+
+#: HBM bandwidth per chip (bytes/s), for the achieved-fraction context
+#: next to the absolute GB/s estimate (same sources as PEAK_FLOPS).
+HBM_BYTES_PER_SEC = {
+    "TPU v6e": 1640e9,
+    "TPU v6 lite": 1640e9,
+    "TPU v5p": 2765e9,
+    "TPU v5e": 819e9,
+    "TPU v5 lite": 819e9,
+    "TPU v4": 1228e9,
+    "TPU v3": 900e9,
+    "TPU v2": 700e9,
+}
+
+
+def _lookup(table: dict[str, float], device_kind: str) -> float | None:
+    return next((v for k, v in table.items() if k in device_kind), None)
+
+
+def peak_flops_for(device_kind: str, override_tflops: float = 0.0
+                   ) -> float | None:
+    """Peak bf16 FLOPs/s for MFU: the ``--peak_tflops`` override when
+    given (custom hardware, CPU calibration runs), else the spec table,
+    else None (MFU is then omitted, never invented)."""
+    if override_tflops and override_tflops > 0:
+        return float(override_tflops) * 1e12
+    return _lookup(PEAK_FLOPS, device_kind)
+
+
+def cost_of(compiled) -> dict:
+    """FLOPs + bytes of one executable from XLA's own cost analysis
+    (zeros when the backend exposes none — cost analysis is best-effort).
+    Shared home (r13): bench.py and tools/mfu_probe.py import this."""
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        return {
+            "flops": float(cost.get("flops", 0.0)),
+            "bytes": float(cost.get("bytes accessed", 0.0)),
+        }
+    except Exception:  # noqa: BLE001
+        return {"flops": 0.0, "bytes": 0.0}
+
+
+def static_cost_model(compiled, axis_sizes: dict[str, int] | None = None,
+                      hlo_text: str | None = None) -> dict[str, Any]:
+    """The a-priori per-step budget of one compiled train step.
+
+    ``compiled`` is the AOT executable (``jit(...).lower(...).compile()``)
+    the engine builds at startup under ``--perf_report``/``--hlo_report``;
+    ``hlo_text`` lets a caller that already holds ``compiled.as_text()``
+    (the shared startup compile) avoid dumping the multi-MB module twice.
+
+    Returns a JSON-ready dict:
+
+    - ``flops_per_step`` / ``hbm_bytes_per_step`` — XLA cost analysis
+      (model FLOPs in the MFU sense: whatever the compiled program does,
+      including remat recompute — the honest denominator input);
+    - ``wire_bytes_data`` / ``wire_bytes_model`` / ``wire_bytes_total``
+      — estimated collective bytes per step from the op census, family-
+      attributed to mesh axes (gather family → ``data``, ring family →
+      ``model``; the r11 convention). Axes of size <= 1 contribute zero
+      regardless of census text (a single-replica program may still
+      contain degenerate collectives);
+    - ``collective_ops`` — the raw per-opcode census (count + bytes).
+    """
+    axis_sizes = dict(axis_sizes or {})
+    c = cost_of(compiled)
+    if hlo_text is None:
+        try:
+            hlo_text = compiled.as_text()
+        except Exception:  # noqa: BLE001
+            hlo_text = ""
+    census = op_census(hlo_text)
+    data_live = axis_sizes.get("data", 1) > 1
+    model_live = axis_sizes.get("model", 1) > 1
+    wire_data = sum(v["wire_bytes"] for k, v in census.items()
+                    if k in GATHER_FAMILY) if data_live else 0
+    wire_model = sum(v["wire_bytes"] for k, v in census.items()
+                     if k in RING_FAMILY) if model_live else 0
+    return {
+        "flops_per_step": c["flops"],
+        "hbm_bytes_per_step": c["bytes"],
+        "wire_bytes_data": int(wire_data),
+        "wire_bytes_model": int(wire_model),
+        "wire_bytes_total": int(wire_data + wire_model),
+        "collective_ops": census,
+    }
+
+
+class PerfAttribution:
+    """Rolling runtime attribution over the static budget.
+
+    Built once at engine startup; the loop feeds cumulative counters and
+    calls :meth:`interval` at the perf cadence. All methods are cheap
+    host float math — nothing here touches a device.
+
+    ``n_devices`` scales the per-chip peak/bandwidth figures to the whole
+    program (cost analysis reports whole-program FLOPs).
+    """
+
+    def __init__(self, cost_model: dict[str, Any] | None, *,
+                 device_kind: str = "", n_devices: int = 1,
+                 peak_tflops_override: float = 0.0):
+        self.cost_model = cost_model or {}
+        self.n_devices = max(int(n_devices), 1)
+        peak1 = peak_flops_for(device_kind, peak_tflops_override)
+        self.peak_flops = peak1 * self.n_devices if peak1 else None
+        ici1 = _lookup(ICI_BYTES_PER_SEC, device_kind)
+        self.ici_bytes_per_sec = ici1 * self.n_devices if ici1 else None
+        hbm1 = _lookup(HBM_BYTES_PER_SEC, device_kind)
+        self.hbm_bytes_per_sec = hbm1 * self.n_devices if hbm1 else None
+
+    def describe(self) -> dict[str, Any]:
+        """Startup-log summary of the static budget + the rate ceilings
+        the runtime fractions will be computed against."""
+        cm = self.cost_model
+        out = {
+            "model_gflops_per_step": round(
+                cm.get("flops_per_step", 0.0) / 1e9, 3),
+            "hbm_gb_per_step": round(
+                cm.get("hbm_bytes_per_step", 0.0) / 1e9, 4),
+            "wire_mb_per_step_data": round(
+                cm.get("wire_bytes_data", 0) / 1e6, 3),
+            "wire_mb_per_step_model": round(
+                cm.get("wire_bytes_model", 0) / 1e6, 3),
+        }
+        if self.peak_flops:
+            out["peak_tflops"] = round(self.peak_flops / 1e12, 2)
+        if self.ici_bytes_per_sec:
+            out["ici_gbps"] = round(self.ici_bytes_per_sec / 1e9, 1)
+        return out
+
+    def interval(self, *, wall_s: float, steps: int,
+                 input_wait_s: float = 0.0, device_wait_s: float = 0.0,
+                 producer_idle_s: float = 0.0) -> dict[str, float]:
+        """Attribute one interval of ``steps`` steps over ``wall_s``
+        seconds of loop wall-clock.
+
+        ``input_wait_s``: time the loop blocked on the loader (the
+        consumer_wait delta). ``device_wait_s``: time the loop blocked in
+        the dispatch-depth barrier's fence read — in a device-bound
+        steady state this IS the device time the host observed.
+        ``producer_idle_s``: the prefetch thread's full-queue idle time
+        (slack indicator — reported, never a fraction: it overlaps
+        compute by construction).
+
+        Returns the ``perf_*`` fields for the progress record. The four
+        fractions sum to exactly 1.0: input and host are measured, and
+        the observed device share splits compute:comm by the static
+        model's estimated times (everything compute when no comm budget
+        or bandwidth figure exists). MFU follows the PaLM convention —
+        model FLOPs over TOTAL wall (all overheads in the denominator).
+        """
+        wall_s = max(float(wall_s), 1e-9)
+        steps = max(int(steps), 0)
+        out: dict[str, float] = {}
+        frac_input = min(max(input_wait_s, 0.0) / wall_s, 1.0)
+        frac_device = min(max(device_wait_s, 0.0) / wall_s,
+                          1.0 - frac_input)
+        frac_host = max(0.0, 1.0 - frac_input - frac_device)
+
+        flops = self.cost_model.get("flops_per_step", 0.0) * steps
+        wire = self.cost_model.get("wire_bytes_total", 0) * steps
+        hbm = self.cost_model.get("hbm_bytes_per_step", 0.0) * steps
+
+        # split the OBSERVED device share by the static model's estimated
+        # compute vs comm times; with no wire budget / no bandwidth
+        # figure the device share is all compute (single-axis runs, CPU)
+        comm_est_s = (wire / self.ici_bytes_per_sec
+                      if wire and self.ici_bytes_per_sec else 0.0)
+        compute_est_s = (flops / self.peak_flops
+                         if flops and self.peak_flops else 0.0)
+        total_est = comm_est_s + compute_est_s
+        comm_share = comm_est_s / total_est if total_est > 0 else 0.0
+        out["perf_frac_input"] = round(frac_input, 4)
+        out["perf_frac_host"] = round(frac_host, 4)
+        out["perf_frac_comm"] = round(frac_device * comm_share, 4)
+        out["perf_frac_compute"] = round(
+            frac_device - frac_device * comm_share, 4)
+
+        if steps:
+            out["perf_step_ms"] = round(1e3 * wall_s / steps, 3)
+        if flops and self.peak_flops:
+            out["perf_mfu"] = round(flops / wall_s / self.peak_flops, 4)
+            out["perf_tflops_per_sec"] = round(flops / wall_s / 1e12, 3)
+        if hbm:
+            out["perf_hbm_gbps"] = round(hbm / wall_s / 1e9, 2)
+            if self.hbm_bytes_per_sec:
+                out["perf_hbm_frac_of_peak"] = round(
+                    hbm / wall_s / self.hbm_bytes_per_sec, 4)
+        if wire:
+            out["perf_wire_gbps"] = round(wire / wall_s / 1e9, 3)
+        if producer_idle_s:
+            # input-path slack, not a wall-clock fraction: the producer
+            # idles concurrently with compute (large values + ~zero
+            # frac_input = the input pipeline has headroom)
+            out["perf_producer_idle_ms_per_step"] = round(
+                1e3 * producer_idle_s / max(steps, 1), 3)
+        return out

@@ -1,0 +1,187 @@
+"""Goodput ledger: every second of the run, bucketed and durable.
+
+Throughput metrics describe the *steps that ran*; on a preemptible fleet
+the number that decides the bill is what fraction of wall-clock was
+productive training at all — the rest went to compiles, checkpoint
+saves, restores, input stalls, or simply being dead between preemption
+and reschedule. The big production stacks account this as *ML goodput*;
+this module is that ledger, sized for this engine:
+
+- :data:`BUCKETS` — ``productive_step`` (loop iterations doing training
+  work), ``compile`` (the startup trace+compile and any mid-run
+  re-trace), ``checkpoint_save`` (synchronous save scheduling + the
+  final durability wait), ``restore`` (checkpoint restore + state
+  init), ``input_stall`` (the loop blocked on the loader),
+  ``eval`` (in-loop evaluation), ``halted`` (wall-clock lost BETWEEN
+  attempts: preemption to reschedule, measured as the gap from the
+  previous attempt's last heartbeat to this attempt's start), and
+  ``other`` (side work that fits nowhere else, e.g. the divergence
+  allgather).
+- **Restart accumulation** — the ledger persists to
+  ``<output_dir>/goodput.json`` and every new attempt LOADS the previous
+  totals first, so an elastic run that was preempted five times reports
+  its true end-to-end goodput, not the last attempt's. The per-attempt
+  split is kept alongside the cumulative totals.
+
+Accounting is wall-clock honest at the second level, not trace-exact:
+each loop iteration's interval is split input-first (measured), then
+explicit side-work durations (measured), remainder productive. Overlap
+(an async checkpoint draining under compute) therefore lands in
+``productive_step`` — correctly: the run WAS training during it.
+
+Host-0 writes the file; every process keeps the in-memory ledger (the
+engine logs the summary everywhere, rank-tagged).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Any
+
+from ..utils import get_logger, is_main_process
+from ..utils.serialization import json_sanitize
+
+log = get_logger(__name__)
+
+#: every bucket the ledger tracks; ``goodput`` = productive_step over the
+#: sum of them all
+BUCKETS = ("productive_step", "compile", "checkpoint_save", "restore",
+           "input_stall", "eval", "halted", "other")
+
+FILENAME = "goodput.json"
+
+
+class GoodputLedger:
+    """Accumulate per-bucket wall-clock; persist + merge across restarts."""
+
+    def __init__(self, output_dir: str | Path, *, now: float | None = None):
+        self.path = Path(output_dir) / FILENAME
+        self._t_start = time.time() if now is None else float(now)
+        self._current: dict[str, float] = {b: 0.0 for b in BUCKETS}
+        self._prior: dict[str, float] = {b: 0.0 for b in BUCKETS}
+        self._prior_attempts: list[dict[str, Any]] = []
+        self.attempt = 1
+        #: the engine sets this True when the run reached its step budget
+        #: (NOT on a SIGTERM/anomaly stop): the flag persists, and the
+        #: NEXT attempt then skips the downtime gap — resuming a
+        #: finished run with a larger --max_steps days later is a
+        #: workflow, not a preemption
+        self.completed = False
+        prior = self._load_prior()
+        if prior is not None:
+            for b in BUCKETS:
+                self._prior[b] = float(prior.get("buckets", {}).get(b, 0.0))
+            self._prior_attempts = list(prior.get("attempts_log", []))[-32:]
+            self.attempt = int(prior.get("attempt", 0)) + 1
+            # downtime between attempts: the previous attempt's last
+            # heartbeat to now — the bucket preemption actually costs a
+            # fleet. Skipped when the prior attempt finished cleanly (a
+            # fresh attempt with no prior file has no downtime either)
+            last = prior.get("last_updated")
+            if (not prior.get("completed")
+                    and isinstance(last, (int, float)) and last > 0):
+                gap = self._t_start - float(last)
+                if gap > 0:
+                    self._prior["halted"] += gap
+
+    def _load_prior(self) -> dict[str, Any] | None:
+        try:
+            if self.path.is_file():
+                return json.loads(self.path.read_text())
+        except Exception:  # noqa: BLE001 - a corrupt ledger must not kill
+            log.exception("goodput.json unreadable; starting a fresh ledger")
+        return None
+
+    # -- accounting --------------------------------------------------------
+    def add(self, bucket: str, seconds: float) -> None:
+        """Add ``seconds`` of wall-clock to ``bucket`` (unknown bucket
+        names land in ``other`` rather than raising — the ledger must
+        never cost the run it measures)."""
+        if seconds <= 0:
+            return
+        if bucket not in self._current:
+            bucket = "other"
+        self._current[bucket] += float(seconds)
+
+    def split_iteration(self, dt: float, *, input_s: float = 0.0,
+                        compile_s: float = 0.0, save_s: float = 0.0,
+                        eval_s: float = 0.0, other_s: float = 0.0) -> None:
+        """Split one loop-iteration interval ``dt`` across buckets:
+        measured components first (clamped so the sum never exceeds
+        ``dt``), remainder productive."""
+        if dt <= 0:
+            return
+        remaining = dt
+        for bucket, s in (("input_stall", input_s), ("compile", compile_s),
+                          ("checkpoint_save", save_s), ("eval", eval_s),
+                          ("other", other_s)):
+            take = min(max(s, 0.0), remaining)
+            if take > 0:
+                self._current[bucket] += take
+                remaining -= take
+        if remaining > 0:
+            self._current["productive_step"] += remaining
+
+    # -- reporting ---------------------------------------------------------
+    def totals(self) -> dict[str, float]:
+        """Cumulative buckets: every prior attempt plus this one."""
+        return {b: self._prior[b] + self._current[b] for b in BUCKETS}
+
+    def summary(self) -> dict[str, Any]:
+        tot = self.totals()
+        wall = sum(tot.values())
+        return {
+            "goodput": round(tot["productive_step"] / wall, 4) if wall else None,
+            "wall_s": round(wall, 1),
+            "attempt": self.attempt,
+            "buckets_s": {b: round(v, 1) for b, v in tot.items()},
+        }
+
+    def flush(self, *, min_interval_s: float = 0.0) -> None:
+        """Write ``goodput.json`` (host 0 only; best-effort — telemetry
+        must never kill training). Called at the perf/logging cadence and
+        from the engine's shutdown path.
+
+        ``min_interval_s`` rate-limits mid-run heartbeats: the file's
+        ``last_updated`` only needs enough resolution to bound the next
+        attempt's downtime gap, and an unconditional write per logging
+        interval would dominate sub-ms toy steps (measured in
+        BENCH_MODE=perf). Shutdown paths pass the default 0 = always."""
+        if not is_main_process():
+            return
+        now = time.time()
+        if min_interval_s > 0 and now - getattr(self, "_last_flush", 0.0) \
+                < min_interval_s:
+            return
+        self._last_flush = now
+        tot = self.totals()
+        wall = sum(tot.values())
+        payload = {
+            "schema": "goodput/v1",
+            "attempt": self.attempt,
+            "completed": bool(self.completed),
+            "goodput": (tot["productive_step"] / wall) if wall else None,
+            "wall_s": wall,
+            "buckets": tot,
+            "current_attempt_buckets": dict(self._current),
+            "attempts_log": self._prior_attempts + [{
+                "attempt": self.attempt,
+                "started": self._t_start,
+                "wall_s": sum(self._current.values()),
+            }],
+            "last_updated": time.time(),
+            "note": "buckets accumulate across restarts; 'halted' is the "
+                    "wall-clock between one attempt's last heartbeat and "
+                    "the next attempt's start (preemption downtime)",
+        }
+        try:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            tmp = self.path.with_suffix(".json.tmp")
+            tmp.write_text(json.dumps(json_sanitize(payload), indent=2,
+                                      allow_nan=False))
+            tmp.replace(self.path)  # atomic: a kill mid-write never leaves
+            #                         a truncated ledger for the next attempt
+        except Exception:  # noqa: BLE001
+            log.exception("goodput.json write failed (ledger kept in memory)")

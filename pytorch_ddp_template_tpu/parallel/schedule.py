@@ -260,9 +260,14 @@ def decomposed_scan(schedule: Any,
             y, wc = carry
             # schedule state FIRST: anything issued here (the fsdp
             # prefetch gather) is independent of this layer's compute by
-            # construction, visible as such in the lowered loop body
-            w, wc = schedule.fwd_weights(stacked, wc, k)
-            y = schedule.fwd_apply(apply_fn, w, y, k, extras)
+            # construction, visible as such in the lowered loop body.
+            # named_scope = trace-time metadata only (r13): profiler
+            # traces and HLO dumps show the schedule phase instead of
+            # anonymous op soup; zero runtime cost
+            with jax.named_scope("sched_weights"):
+                w, wc = schedule.fwd_weights(stacked, wc, k)
+            with jax.named_scope("sched_block_fwd"):
+                y = schedule.fwd_apply(apply_fn, w, y, k, extras)
             return (y, wc), None
 
         (y, _), _ = lax.scan(body, (x, wc0), ks)
@@ -273,8 +278,10 @@ def decomposed_scan(schedule: Any,
 
         def body(carry, k):
             y, wc = carry
-            w, wc = schedule.fwd_weights(stacked, wc, k)
-            y_out = schedule.fwd_apply(apply_fn, w, y, k, extras)
+            with jax.named_scope("sched_weights"):
+                w, wc = schedule.fwd_weights(stacked, wc, k)
+            with jax.named_scope("sched_block_fwd"):
+                y_out = schedule.fwd_apply(apply_fn, w, y, k, extras)
             # save each layer's INPUT activation: the boundary residual
             # the backward recomputes from
             return (y_out, wc), y
@@ -292,14 +299,16 @@ def decomposed_scan(schedule: Any,
             k, x_k, res_k = inputs
             key_k = (None if comm_rng is None
                      else jax.random.fold_in(comm_rng, k))
-            gy, wc, gacc, ys = schedule.bwd_step(
-                apply_fn, stacked, wc, gacc, k, x_k, gy, extras,
-                res_k, key_k)
+            with jax.named_scope("sched_block_bwd"):
+                gy, wc, gacc, ys = schedule.bwd_step(
+                    apply_fn, stacked, wc, gacc, k, x_k, gy, extras,
+                    res_k, key_k)
             return (gy, wc, gacc), ys
 
         (gx, _, gacc), ys = lax.scan(
             body, (gy, wc0, gacc0), (ks, xs, residual), reverse=True)
-        grads, res_ct = schedule.finalize(gacc, ys)
+        with jax.named_scope("sched_grad_finalize"):
+            grads, res_ct = schedule.finalize(gacc, ys)
         if residual is None:
             res_ct = None
         key_ct = (None if comm_rng is None

@@ -52,9 +52,10 @@ from ..data.loader import ShardedLoader
 from ..models.task import Task
 from ..runtime.context import DATA_AXIS, RuntimeContext
 from ..utils import get_logger, is_main_process
+from ..obs.goodput import GoodputLedger
 from ..obs.health import HEALTH_KEYS
 from ..utils.divergence import DivergenceMonitor
-from ..utils.profiler import StepTimer, TraceWindow
+from ..utils.profiler import StepTimer, TraceWindow, annotate
 from .metrics import MetricsWriter, SyncTelemetry, make_telemetry
 from .schedule import SCHEDULES
 
@@ -397,6 +398,28 @@ class Trainer:
         self._halt_vote = False
         self._halt_at_step: int | None = None
         self._flight_trace: TraceWindow | None = None
+        # goodput ledger (obs/goodput.py): always on — host-side float
+        # adds per iteration + one JSON write per perf interval. Loads
+        # any prior attempt's buckets from <output_dir>/goodput.json so
+        # a preempted-and-restarted run reports TRUE end-to-end goodput
+        self.goodput = GoodputLedger(config.output_dir)
+        # perf attribution (--perf_report): built by _startup_reports
+        # from the shared AOT compile; None = no attribution records
+        self.perf = None
+        # mid-run retrace detection (goodput `compile` bucket + the
+        # shape-change warning): the jit cache grows exactly when a
+        # dispatch traced+compiled a new executable
+        self._jit_cache_size = 0
+        # side-work durations measured where they happen, consumed by
+        # the next timer tick's goodput split (the tick interval is the
+        # wall-clock they are part of)
+        self._pending: dict[str, float] = {
+            "compile": 0.0, "checkpoint_save": 0.0,
+            "eval": 0.0, "other": 0.0}
+        # cumulative loop time spent blocked in the dispatch-depth
+        # barrier's fence read — the device-wait measure the perf
+        # attribution splits into compute vs comm
+        self._device_wait_s = 0.0
 
     # -- state ------------------------------------------------------------
     def init_state(self) -> TrainState:
@@ -557,7 +580,11 @@ class Trainer:
 
     def train(self) -> TrainState:
         cfg = self.config
+        t_restore = time.perf_counter()
         state, start_step = self.restore_or_init()
+        # restore + init + placement: pre-training wall the goodput
+        # ledger must not count as productive
+        self.goodput.add("restore", time.perf_counter() - t_restore)
         from ..parallel.sharding import describe
 
         log.info(
@@ -581,13 +608,15 @@ class Trainer:
             },
         )
 
-        if cfg.hlo_report:
-            # best-effort by design: a report/tripwire failure must never
-            # cost the training run it exists to protect
+        if cfg.hlo_report or cfg.perf_report:
+            # best-effort by design: a report/tripwire/attribution
+            # failure must never cost the training run it exists to
+            # protect. ONE shared AOT compile feeds both consumers.
             try:
-                self._emit_hlo_report(state)
+                self._startup_reports(state)
             except Exception:  # noqa: BLE001
-                log.exception("--hlo_report failed; continuing without it")
+                log.exception("--hlo_report/--perf_report startup analysis "
+                              "failed; continuing without it")
 
         # graceful preemption (SLURM/TPU-VM maintenance send SIGTERM):
         # finish the in-flight step, checkpoint, exit cleanly — the next
@@ -609,6 +638,16 @@ class Trainer:
             # interval when the loop raised) before the writer closes
             self.telemetry.close()
             self.metrics_writer.close()
+            # the ledger's durable heartbeat: a crash/preemption still
+            # leaves goodput.json current, so the NEXT attempt's downtime
+            # gap starts from the truth (pendings drained first — the
+            # crash path never reached the loop-exit drain; idempotent
+            # after a clean exit, which zeroed them)
+            try:
+                self._drain_pending_side_work()
+            except Exception:  # noqa: BLE001
+                pass
+            self.goodput.flush()
             # restore only AFTER the preemption checkpoint is durably
             # written: schedulers re-deliver SIGTERM during the grace
             # window, and a default handler mid-save would defeat the
@@ -637,10 +676,47 @@ class Trainer:
                 votes = self._stop_votes[local] = make_stop_flags(
                     self.ctx.mesh, local
                 )
-            state, metrics = self.train_step(state, batch, votes)
+            args = (state, batch, votes)
+        else:
+            args = (state, batch)
+        t0 = time.perf_counter()
+        with annotate("train_step_dispatch"):
+            state, metrics = self.train_step(*args)
+        self._note_dispatch(time.perf_counter() - t0)
+        if self._with_stop:
             return state, metrics, metrics.pop("stop_agreed")
-        state, metrics = self.train_step(state, batch)
         return state, metrics, metrics["loss"]
+
+    def _note_dispatch(self, dt: float) -> None:
+        """Post-dispatch bookkeeping: when the jit executable cache grew,
+        this dispatch traced+compiled — record the duration for the
+        goodput ``compile`` bucket (consumed by the next timer tick's
+        split) and, mid-run, warn: a re-trace means the input
+        shape/bucket or step structure changed, and without this record
+        it masquerades as one mysteriously slow step."""
+        size_fn = getattr(self.train_step, "_cache_size", None)
+        if size_fn is None:  # wrapped step (tests/bench injectors)
+            return
+        try:
+            size = int(size_fn())
+        except Exception:  # noqa: BLE001 - accounting must never cost the run
+            return
+        if size <= self._jit_cache_size:
+            return
+        first = self._jit_cache_size == 0
+        self._jit_cache_size = size
+        self._pending["compile"] += dt
+        if first:
+            # the expected startup trace+compile (the --perf_report/
+            # --hlo_report AOT compile does not populate the jit cache)
+            log.info("train step compiled", {"compile_s": round(dt, 2)})
+        else:
+            log.warning(
+                "train step re-traced mid-run (input shape/bucket or "
+                "structure change) — this step paid a compile, recorded "
+                "in the goodput `compile` bucket",
+                {"compile_s": round(dt, 2), "executables_cached": size},
+            )
 
     def _train_loop(self, state, start_step, stop_signal):
         cfg = self.config
@@ -677,21 +753,48 @@ class Trainer:
         inflight: deque[tuple[int, jax.Array]] = deque()
         t_last = time.perf_counter()
         wait_last = self.loader.stats["consumer_wait_s"]
+        idle_last = self.loader.stats["producer_idle_s"]
         examples_per_step = cfg.train_batch_size * cfg.gradient_accumulation_steps
         start_epoch = start_step // self.steps_per_epoch
         global_step = start_step
         done = False
+        # perf/goodput cadence: --perf_every, falling back to the logging
+        # cadence (perf fields then merge into the progress record)
+        perf_every = cfg.perf_every or cfg.logging_steps
+        # interval marks for the attribution deltas + the ledger's
+        # per-iteration input split (separate from wait_last, which the
+        # logging block owns)
+        self._gp_wait_last = wait_last
+        self._perf_marks = {
+            "time": t_last, "step": global_step, "wait": wait_last,
+            "idle": idle_last, "device_wait": self._device_wait_s,
+        }
+        # durable attempt marker BEFORE the first step: a hard kill
+        # (SIGKILL/OOM — no finally runs) must still leave this attempt
+        # and its inherited downtime on disk for the next attempt's
+        # accounting; the in-loop heartbeat below keeps it fresh even
+        # when --logging_steps 0 disables the perf cadence
+        self.goodput.flush()
         # the loop proper runs under a crash guard: an exception mid-loop
         # must still stop any live profiler trace (losing the partially
         # captured profile of a crashed run loses the one you want most)
         # and give the flight recorder its chance to dump the ring buffer
         try:
+            no_more = object()
             for epoch in range(start_epoch, self.num_epochs):
                 # on resume mid-epoch, drop already-consumed batches in the
                 # loader (before generation/transfer) so the data order matches
                 # an uninterrupted run
                 skip = start_step % self.steps_per_epoch if epoch == start_epoch else 0
-                for batch in self.loader.epoch(epoch, start_batch=skip):
+                batches = self.loader.epoch(epoch, start_batch=skip)
+                while True:
+                    # explicit next() so the time blocked on the loader
+                    # carries its phase name in captured traces (the
+                    # loader's consumer_wait_s counter measures it)
+                    with annotate("input_wait"):
+                        batch = next(batches, no_more)
+                    if batch is no_more:
+                        break
                     # flight trace first: if its window ends exactly where
                     # the main --profile_steps window begins, it must stop
                     # before trace.step() starts the next capture (one
@@ -702,8 +805,33 @@ class Trainer:
                     state, metrics, fence = self._dispatch(state, batch, stop_signal)
                     # an interval that included eval/save/divergence work last
                     # iteration is not a step time — keep percentiles honest
-                    timer.tick(discard=side_work)
+                    dt = timer.tick(discard=side_work)
                     side_work = False
+                    # goodput: split this iteration's wall across buckets
+                    # — measured parts (input stall, compile/save/eval
+                    # durations recorded since the last tick) first,
+                    # remainder productive. The pre-baseline first
+                    # interval has no dt; ledger its measured parts only.
+                    gp_wait = self.loader.stats["consumer_wait_s"]
+                    pend = self._pending
+                    if dt is None:
+                        self.goodput.add("compile", pend["compile"])
+                        self.goodput.add("input_stall",
+                                         gp_wait - self._gp_wait_last)
+                    else:
+                        self.goodput.split_iteration(
+                            dt, input_s=gp_wait - self._gp_wait_last,
+                            compile_s=pend["compile"],
+                            save_s=pend["checkpoint_save"],
+                            eval_s=pend["eval"], other_s=pend["other"])
+                    self._gp_wait_last = gp_wait
+                    for k in pend:
+                        pend[k] = 0.0
+                    # cadence-independent ledger heartbeat: one time.time()
+                    # compare per iteration, one JSON write per minute at
+                    # most — so a hard-killed --logging_steps 0 run still
+                    # leaves a near-current goodput.json behind
+                    self.goodput.flush(min_interval_s=60.0)
                     global_step += 1
                     inflight.append((global_step, fence))
                     if cfg.logging_steps:  # window only consumed when logging
@@ -724,13 +852,20 @@ class Trainer:
 
                     stop_now = False
                     if paced:
-                        while len(inflight) > max_inflight:
-                            _, fval = inflight.popleft()
-                            # the barrier: one scalar host read of a step K
-                            # dispatches old — complete in steady state
-                            fval = jax.device_get(fval)
-                            if self._with_stop and int(fval):
-                                stop_now = True
+                        t_fence = time.perf_counter()
+                        with annotate("device_wait"):
+                            while len(inflight) > max_inflight:
+                                _, fval = inflight.popleft()
+                                # the barrier: one scalar host read of a
+                                # step K dispatches old — complete in
+                                # steady state
+                                fval = jax.device_get(fval)
+                                if self._with_stop and int(fval):
+                                    stop_now = True
+                        # device-bound loops park HERE: the fence wait is
+                        # the loop's observable device time, the quantity
+                        # the perf attribution splits compute vs comm
+                        self._device_wait_s += time.perf_counter() - t_fence
                     else:
                         while len(inflight) > max_inflight:
                             inflight.popleft()
@@ -749,6 +884,13 @@ class Trainer:
                         if trig is not None:
                             self._on_anomaly_trigger(state, trig,
                                                      global_step, trace)
+
+                    # perf/goodput cadence: attribution snapshot + ledger
+                    # flush; merged into the progress record when the two
+                    # cadences land on the same step, else its own record
+                    perf_rec = None
+                    if perf_every and global_step % perf_every == 0:
+                        perf_rec = self._perf_snapshot(global_step)
 
                     if cfg.logging_steps and global_step % cfg.logging_steps == 0:
                         if isinstance(telemetry, SyncTelemetry):
@@ -771,6 +913,7 @@ class Trainer:
                         steps_per_s = cfg.logging_steps / (now - t_last)
                         t_last = now
                         wait_now = self.loader.stats["consumer_wait_s"]
+                        idle_now = self.loader.stats["producer_idle_s"]
                         scalars = {
                             "loss": loss_val,
                             "lr": metrics["lr"],
@@ -778,6 +921,13 @@ class Trainer:
                             "steps_per_sec": steps_per_s,
                             "examples_per_sec": steps_per_s * examples_per_step,
                             "input_wait_ms": 1e3 * (wait_now - wait_last)
+                            / cfg.logging_steps,
+                            # the prefetch thread's full-queue idle time:
+                            # the input pipeline's SLACK (large values +
+                            # ~zero input_wait_ms = headroom; both ~zero =
+                            # the producer is the bottleneck). Counted by
+                            # the loader since r8, surfaced here since r13
+                            "producer_idle_ms": 1e3 * (idle_now - idle_last)
                             / cfg.logging_steps,
                             "timer": timer_val,
                         }
@@ -789,6 +939,10 @@ class Trainer:
                             if k in metrics:
                                 scalars[k] = metrics[k]
                         wait_last = wait_now
+                        idle_last = idle_now
+                        if perf_rec:
+                            scalars.update(perf_rec)
+                            perf_rec = None
                         telemetry.emit(global_step, scalars, kind="progress")
                         # snapshot: the drain thread rebinds .latest (possibly
                         # to an eval record with no 'loss') between a check
@@ -799,9 +953,17 @@ class Trainer:
                             # stale postfix for an unstalled dispatch pipeline
                             pbar.set_postfix(loss=f"{latest['loss']:.4f}")
 
+                    if perf_rec:
+                        # --perf_every off the logging cadence (or
+                        # logging off): the snapshot is its own record
+                        telemetry.emit(global_step, perf_rec, kind="perf")
+
                     if cfg.eval_steps and global_step % cfg.eval_steps == 0:
                         side_work = True
-                        ev = self.evaluate(state)
+                        t_eval = time.perf_counter()
+                        with annotate("eval"):
+                            ev = self.evaluate(state)
+                        self._pending["eval"] += time.perf_counter() - t_eval
                         if ev:
                             telemetry.emit(global_step, ev, kind="eval")
 
@@ -811,8 +973,10 @@ class Trainer:
                         # (async); the fetch+allgather completes via poll() once
                         # it is max_inflight steps old — off the critical path
                         self.divergence.submit(state.params, global_step)
+                    t_div = time.perf_counter()
                     if self.divergence.poll(global_step) is not None:
                         side_work = True  # the DCN allgather ran this iteration
+                        self._pending["other"] += time.perf_counter() - t_div
 
                     if cfg.save_steps and global_step % cfg.save_steps == 0:
                         # async orbax save: schedule-and-return. Only discard
@@ -821,8 +985,10 @@ class Trainer:
                         # unconditional discard would blind the percentiles to
                         # every save-adjacent step
                         t_save = time.perf_counter()
-                        self.ckpt.save(global_step, state, cfg)
+                        with annotate("checkpoint_save"):
+                            self.ckpt.save(global_step, state, cfg)
                         save_ms = 1e3 * (time.perf_counter() - t_save)
+                        self._pending["checkpoint_save"] += save_ms / 1e3
                         p50 = timer.p50_ms() if self.ckpt.is_async else None
                         side_work = side_work or p50 is None or \
                             save_ms > max(0.25 * p50, 1.0)
@@ -879,14 +1045,73 @@ class Trainer:
             if self._flight_trace is not None:
                 self._flight_trace.close()
 
+        # side-work recorded in the FINAL iteration (a last-step eval or
+        # save) has no next tick to consume it — drain it here so the
+        # ledger never silently drops the run's closing minutes
+        self._drain_pending_side_work()
+        # completion marker: only a run that reached its step budget —
+        # a SIGTERM/anomaly stop leaves it False, so the NEXT attempt
+        # books the reschedule gap as `halted` downtime
+        self.goodput.completed = (global_step >= self.total_steps
+                                  and stop_signal["sig"] is None
+                                  and not self._halt_vote)
         self.divergence.drain()  # identical pending set on every process
-        if self.ckpt.latest_step() != global_step:  # avoid duplicate final save
-            self.ckpt.save(global_step, state, cfg, force=True)
-        self.ckpt.wait()
+        t_final = time.perf_counter()
+        with annotate("checkpoint_save"):
+            if self.ckpt.latest_step() != global_step:  # no duplicate final save
+                self.ckpt.save(global_step, state, cfg, force=True)
+            self.ckpt.wait()  # the durability barrier IS checkpoint time
+        self.goodput.add("checkpoint_save", time.perf_counter() - t_final)
         log.info("training complete", {"global_step": global_step})
+        # the end-of-run goodput line: true end-to-end accounting, every
+        # prior attempt of this output_dir included (obs/goodput.py)
+        log.info("goodput summary", self.goodput.summary())
+        self.goodput.flush()
         return state
 
     # -- observability ----------------------------------------------------
+    def _drain_pending_side_work(self) -> None:
+        """Move any unconsumed side-work durations into the ledger and
+        zero them (idempotent). The per-iteration tick normally consumes
+        them; the run's LAST iteration has no next tick."""
+        for bucket, s in self._pending.items():
+            self.goodput.add(bucket, s)
+            self._pending[bucket] = 0.0
+
+    def _perf_snapshot(self, global_step: int) -> dict[str, float]:
+        """One perf-cadence tick: flush the goodput ledger and (when
+        ``--perf_report`` built an attribution) compute the interval's
+        MFU + compute/comm/host/input fractions from the deltas since
+        the last snapshot. Returns flat float fields ready for a
+        telemetry record."""
+        now = time.perf_counter()
+        stats = self.loader.stats
+        marks = self._perf_marks
+        rec: dict[str, float] = {}
+        if self.perf is not None:
+            rec = self.perf.interval(
+                wall_s=now - marks["time"],
+                steps=global_step - marks["step"],
+                input_wait_s=stats["consumer_wait_s"] - marks["wait"],
+                device_wait_s=self._device_wait_s - marks["device_wait"],
+                producer_idle_s=stats["producer_idle_s"] - marks["idle"],
+            )
+        self._perf_marks = {
+            "time": now, "step": global_step,
+            "wait": stats["consumer_wait_s"],
+            "idle": stats["producer_idle_s"],
+            "device_wait": self._device_wait_s,
+        }
+        gp = self.goodput.summary()
+        if gp["goodput"] is not None:
+            rec["goodput"] = gp["goodput"]
+        rec["goodput_wall_s"] = gp["wall_s"]
+        # heartbeat, rate-limited: the downtime gap the next attempt
+        # computes only needs ~10s resolution, and an unconditional
+        # write would tax sub-ms steps at tight logging cadences
+        self.goodput.flush(min_interval_s=10.0)
+        return rec
+
     def _on_anomaly_trigger(self, state, trig, global_step, main_trace):
         """Handle a sentry trigger on the loop thread: dump the triage
         bundle, arm a short profiler capture over the NEXT few steps into
@@ -962,22 +1187,59 @@ class Trainer:
             step=int(trigger.get("step", 0)), trigger=trigger, ring=ring,
             config=self.config, describe_snapshot=desc, fingerprint=fp)
 
-    def _emit_hlo_report(self, state):
-        """``--hlo_report``: compile the train step ahead of the loop and
-        write the schedule report + tripwire warnings (obs/hlo_report.py)
-        to ``<output_dir>/hlo_report.json``. Costs one extra ahead-of-time
-        compilation (the loop's first call still compiles through the jit
-        cache); opt-in for exactly that reason."""
-        from ..obs.hlo_report import check_overlap_expectations, schedule_report
-
+    def _startup_reports(self, state):
+        """``--hlo_report`` / ``--perf_report``: ONE ahead-of-time
+        compile of the train step feeding both startup consumers — the
+        HLO schedule report + overlap tripwire, and the perf
+        attribution's static cost model. Costs one extra compilation
+        (the loop's first call still compiles through the jit cache);
+        both flags are opt-in for exactly that reason."""
         example = next(iter(self.loader.epoch(0)))
         args = [state, example]
         if self._with_stop:
             args.append(make_stop_flags(self.ctx.mesh, False))
         t0 = time.perf_counter()
         compiled = self.train_step.lower(*args).compile()
-        report = schedule_report(compiled.as_text())
-        report["compile_s"] = round(time.perf_counter() - t0, 2)
+        compile_s = time.perf_counter() - t0
+        # pre-loop compile wall is exactly what the goodput `compile`
+        # bucket exists to expose
+        self.goodput.add("compile", compile_s)
+        hlo_text = compiled.as_text()
+        if self.config.perf_report:
+            try:
+                self._init_perf(compiled, hlo_text)
+            except Exception:  # noqa: BLE001 - attribution must not
+                #               cost the run (nor the hlo report below)
+                log.exception("--perf_report cost model failed; "
+                              "continuing without attribution")
+        if self.config.hlo_report:
+            self._emit_hlo_report(hlo_text, compile_s)
+
+    def _init_perf(self, compiled, hlo_text: str) -> None:
+        """Build the runtime attribution (obs/attribution.py) from the
+        startup executable: static cost model (FLOPs + HBM bytes from
+        cost analysis, wire bytes per mesh axis from the op census) +
+        the device's peak-rate table (``--peak_tflops`` overrides)."""
+        from ..obs.attribution import PerfAttribution, static_cost_model
+
+        cost_model = static_cost_model(
+            compiled, dict(self.ctx.mesh.shape), hlo_text=hlo_text)
+        devices = self.ctx.mesh.devices
+        self.perf = PerfAttribution(
+            cost_model,
+            device_kind=devices.flat[0].device_kind,
+            n_devices=int(devices.size),
+            peak_tflops_override=self.config.peak_tflops,
+        )
+        log.info("perf attribution cost model", self.perf.describe())
+
+    def _emit_hlo_report(self, hlo_text: str, compile_s: float):
+        """Write the schedule report + tripwire warnings
+        (obs/hlo_report.py) to ``<output_dir>/hlo_report.json``."""
+        from ..obs.hlo_report import check_overlap_expectations, schedule_report
+
+        report = schedule_report(hlo_text)
+        report["compile_s"] = round(compile_s, 2)
         warnings = check_overlap_expectations(
             report, self.config, dict(self.ctx.mesh.shape))
         report["warnings"] = warnings

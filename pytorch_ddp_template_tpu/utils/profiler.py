@@ -5,7 +5,7 @@ rates and TB scalars); for a TPU framework the profiler is table stakes —
 the ≥90% scaling target (BASELINE.md) is won by reading overlap out of
 traces, not by guessing.
 
-Two tools:
+Three tools:
 
 - :class:`TraceWindow` — captures a ``jax.profiler`` trace for steps
   ``[start, start+steps)`` into ``<output_dir>/profile``; view with
@@ -13,10 +13,20 @@ Two tools:
 - :class:`StepTimer` — cheap wall-clock accounting of every step with
   p50/p90/p99 summaries; catches input-bound stalls (step time >> device
   time) without a trace.
+- :func:`annotate` — named host-side phase annotations
+  (``jax.profiler.TraceAnnotation``) around the loop phases (input
+  wait, dispatch, device wait, checkpoint, eval), so every captured
+  trace — ``--profile_steps`` windows AND the flight recorder's
+  post-trigger captures — reads in loop phases instead of raw op soup.
+  A TraceAnnotation outside an active capture is a near-free TraceMe
+  check; :func:`set_phase_annotations` exists so the bench neutrality
+  leg can measure an honest annotations-off baseline, not because the
+  annotations need turning off.
 """
 
 from __future__ import annotations
 
+import contextlib
 import time
 from collections import deque
 from pathlib import Path
@@ -27,6 +37,29 @@ import numpy as np
 from .logging import get_logger
 
 log = get_logger(__name__)
+
+_annotations_enabled = True
+
+_NULL = contextlib.nullcontext()
+
+
+def set_phase_annotations(enabled: bool) -> None:
+    """Globally enable/disable :func:`annotate` (process-wide). Default
+    on; the BENCH_MODE=perf off-leg and tests flip it."""
+    global _annotations_enabled
+    _annotations_enabled = bool(enabled)
+
+
+def phase_annotations_enabled() -> bool:
+    return _annotations_enabled
+
+
+def annotate(name: str):
+    """Context manager naming the enclosed host span ``name`` in any
+    active profiler trace (no-op context when disabled)."""
+    if not _annotations_enabled:
+        return _NULL
+    return jax.profiler.TraceAnnotation(name)
 
 
 class TraceWindow:

@@ -5,11 +5,20 @@
 (``jq``, pandas ``read_json``, a Go/JS dashboard) chokes on the one record
 that mattered most: the step where the loss went NaN. The anomaly sentry
 *intentionally* surfaces non-finite scalars, so every sink that writes
-them (``train/metrics.MetricsWriter``, ``obs/sentry.FlightRecorder``)
-routes records through :func:`json_sanitize` first: the non-finite value
-becomes ``null`` and the original spelling is preserved in a sibling
-``"<key>_repr"`` string — machine-parseable AND lossless for the human
-reading the triage bundle.
+them (``train/metrics.MetricsWriter``, ``obs/sentry.FlightRecorder``,
+``obs/goodput.GoodputLedger``) routes records through
+:func:`json_sanitize` first: the non-finite value becomes ``null`` and
+the original spelling is preserved in a sibling ``"<key>_repr"`` string —
+machine-parseable AND lossless for the human reading the triage bundle.
+
+r13 hardening (first direct unit tests forced the contract to be
+written down): values may also be numpy/jax **device arrays** (0-d
+scalars become numbers, n-d arrays become nested lists — fetching a jax
+array blocks, which is fine for the triage/ledger paths this serves),
+containers **nest** (dicts and lists sanitise recursively), and any
+other object that JSON cannot represent falls back to its ``repr``
+string instead of blowing up the dump — a partially-readable bundle
+beats an exception in the failure path.
 """
 
 from __future__ import annotations
@@ -22,34 +31,72 @@ def _finite(v: float) -> bool:
     return math.isfinite(v)
 
 
+def _coerce(v: Any) -> Any:
+    """Array-likes (numpy scalars/arrays, jax device arrays) to plain
+    Python via ``tolist`` — duck-typed so this module stays importable
+    without numpy or jax."""
+    if isinstance(v, float):
+        # normalise float subclasses (np.float64): repr(np.float64(nan))
+        # spells "np.float64(nan)", and the _repr contract is "nan"
+        return float(v)
+    if v is None or isinstance(v, (bool, int, str, dict, list, tuple)):
+        return v
+    if hasattr(v, "dtype") and hasattr(v, "tolist"):
+        try:
+            return v.tolist()  # 0-d -> number, n-d -> nested lists
+        except Exception:  # noqa: BLE001 - fall through to the repr path
+            pass
+    return v
+
+
+def _element(x: Any) -> Any:
+    """Sanitise one container element: nested dicts/lists recurse,
+    non-finite floats become None (the enclosing list's ``_repr``
+    sibling keeps flat spellings; deeper nesting trades the repr for
+    staying parseable), anything unserialisable becomes its repr."""
+    x = _coerce(x)
+    if x is None or isinstance(x, (bool, str, int)):
+        return x
+    if isinstance(x, float):
+        return x if _finite(x) else None
+    if isinstance(x, dict):
+        return json_sanitize(x)
+    if isinstance(x, (list, tuple)):
+        return [_element(e) for e in x]
+    return repr(x)
+
+
 def json_sanitize(record: dict[str, Any]) -> dict[str, Any]:
     """Return a copy of ``record`` that ``json.dumps(..., allow_nan=False)``
     accepts: non-finite floats become ``None`` plus a ``"<key>_repr"``
     sibling holding the original spelling (``"nan"``, ``"inf"``, ``"-inf"``).
     Lists are sanitised element-wise (one ``_repr`` for the whole list).
-    Nested dicts recurse. Non-numeric values pass through untouched.
+    Nested dicts recurse. Device/numpy arrays convert via ``tolist``
+    first; objects JSON cannot represent serialise as their ``repr``.
     """
     out: dict[str, Any] = {}
     for k, v in record.items():
+        v = _coerce(v)
         if isinstance(v, bool) or v is None:
             out[k] = v
         elif isinstance(v, dict):
             out[k] = json_sanitize(v)
         elif isinstance(v, (list, tuple)):
-            vals = list(v)
+            vals = [_coerce(x) for x in v]
             bad = [x for x in vals
                    if isinstance(x, float) and not _finite(x)]
             if bad:
-                out[k] = [None if isinstance(x, float) and not _finite(x)
-                          else x for x in vals]
+                out[k] = [_element(x) for x in vals]
                 out[f"{k}_repr"] = ("["
                                     + ", ".join(repr(x) for x in vals)
                                     + "]")
             else:
-                out[k] = vals
+                out[k] = [_element(x) for x in vals]
         elif isinstance(v, float) and not _finite(v):
             out[k] = None
             out[f"{k}_repr"] = repr(v)  # 'nan' | 'inf' | '-inf'
-        else:
+        elif isinstance(v, (str, int, float)):
             out[k] = v
+        else:
+            out[k] = repr(v)  # unserialisable object: lossless-ish fallback
     return out

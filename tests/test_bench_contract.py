@@ -412,6 +412,67 @@ def test_obs_record_committed_and_affirmative():
     assert "profile" in last["flight_bundle_files"]
 
 
+@pytest.mark.slow
+def test_perf_mode_contract():
+    """BENCH_MODE=perf: one JSON line carrying the round-13 step-time
+    X-ray legs — the attribution+annotations neutrality pair over the
+    full production loop, the calibrated-peak MFU-sanity leg, the
+    fraction-sum check and the goodput-ledger completeness proof (slow:
+    seven full Trainer runs in a subprocess; the committed record in
+    bench_records/perf_cpu_r13.jsonl is the tier-1-visible evidence)."""
+    code, lines, out = run_bench({
+        "BENCH_MODE": "perf", "BENCH_MODEL": "mlp",
+        "BENCH_BATCH": "8", "BENCH_WARMUP": "1", "BENCH_STEPS": "6",
+        "BENCH_LOG_STEPS": "2", "BENCH_OUTPUT": "/tmp/bench_perf_contract",
+    })
+    assert code == 0, out[-2000:]
+    assert len(lines) == 1, out[-2000:]
+    row = lines[0]
+    assert REQUIRED <= set(row)
+    assert row["metric"] == "perf_attribution_overhead_ratio"
+    assert row["value"] > 0
+    # MFU sanity: in (0, 1] and consistent with the FLOPs-matched step
+    # time (the calibrated peak pins the expectation near 0.25)
+    assert 0.0 < row["mfu_reported"] <= 1.0
+    assert row["mfu_consistent"] is True
+    assert row["model_gflops_per_step"] >= 0
+    # the four fractions are a partition of wall time
+    assert 0.98 <= row["frac_sum"] <= 1.02
+    for k in ("frac_compute", "frac_comm", "frac_host", "frac_input"):
+        assert 0.0 <= row[k] <= 1.0, k
+    # goodput ledger written with the full bucket set
+    assert row["goodput_file_complete"] is True
+    assert row["goodput"] is not None
+
+
+def test_perf_record_committed_and_affirmative():
+    """The committed round-13 CPU record must exist and actually show
+    the evidence the round claims: attribution+annotations inside the
+    0.9 step-time band, MFU in (0, 1] and consistent with the
+    FLOPs-matched step time, fractions summing to ~1, and a complete
+    goodput ledger."""
+    import json
+    from pathlib import Path
+
+    from pytorch_ddp_template_tpu.obs.goodput import BUCKETS
+
+    path = Path(__file__).resolve().parent.parent / "bench_records" / \
+        "perf_cpu_r13.jsonl"
+    assert path.is_file(), "run BENCH_MODE=perf to record the legs"
+    records = [json.loads(l) for l in path.read_text().splitlines() if l]
+    assert records
+    last = records[-1]
+    assert last["metric"] == "perf_attribution_overhead_ratio"
+    assert last["value"] >= 0.9  # neutrality band: the X-ray is ~free
+    assert last["vs_baseline"] >= 1.0
+    assert 0.0 < last["mfu_reported"] <= 1.0
+    assert last["mfu_consistent"] is True
+    assert 0.98 <= last["frac_sum"] <= 1.02
+    assert last["goodput_file_complete"] is True
+    assert set(BUCKETS) <= set(last["goodput_buckets_s"])
+    assert last["goodput_buckets_s"]["compile"] > 0
+
+
 def test_comms_record_committed_and_affirmative():
     """The committed round-9 CPU record must exist and actually show the
     evidence the round claims: >= depth independent in-scan reduces, int8

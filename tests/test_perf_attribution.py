@@ -1,0 +1,425 @@
+"""Round-13 step-time X-ray: obs/attribution.py (static cost model +
+runtime MFU/fraction attribution), obs/goodput.py (the restart-
+accumulating wall-clock ledger), the phase annotations, the engine's
+retrace→compile-bucket accounting, and the CLI-level proof that
+goodput.json survives a kill-and-resume."""
+
+import json
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from pytorch_ddp_template_tpu.obs.attribution import (
+    PEAK_FLOPS,
+    PerfAttribution,
+    cost_of,
+    peak_flops_for,
+    static_cost_model,
+)
+from pytorch_ddp_template_tpu.obs.goodput import BUCKETS, GoodputLedger
+
+
+# -- static cost model -----------------------------------------------------
+
+@pytest.fixture(scope="module")
+def compiled_toy():
+    f = jax.jit(lambda x: (x @ x).sum())
+    x = jnp.ones((32, 32), jnp.float32)
+    return f.lower(x).compile()
+
+
+class TestStaticCostModel:
+    def test_flops_and_bytes_from_cost_analysis(self, compiled_toy):
+        cm = static_cost_model(compiled_toy, {"data": 1})
+        # the CPU backend exposes cost analysis: a 32^3 matmul is ~2*32^3
+        assert cm["flops_per_step"] > 32 ** 3
+        assert cm["hbm_bytes_per_step"] > 0
+        # no live axis, no collectives: zero wire either way
+        assert cm["wire_bytes_total"] == 0
+
+    def test_wire_split_by_family_and_axis(self):
+        hlo = "\n".join([
+            "body1 (a: f32[]) -> f32[] {",
+            "  %g = f32[1024]{0} all-gather(%p), dimensions={0}",
+            "  %r = f32[512]{0} collective-permute(%q), src={{0,1}}",
+            "}",
+        ])
+
+        class FakeCompiled:  # cost analysis absent: zeros, never raises
+            def cost_analysis(self):
+                raise RuntimeError("no backend")
+
+        both = static_cost_model(FakeCompiled(),
+                                 {"data": 4, "model": 2}, hlo_text=hlo)
+        assert both["wire_bytes_data"] == 4096    # gather family -> data
+        assert both["wire_bytes_model"] == 2048   # ring family -> model
+        assert both["wire_bytes_total"] == 6144
+        # a dead axis zeroes ITS family even if the text has the ops
+        #  (degenerate collectives in a single-replica program)
+        data_only = static_cost_model(FakeCompiled(),
+                                      {"data": 4}, hlo_text=hlo)
+        assert data_only["wire_bytes_data"] == 4096
+        assert data_only["wire_bytes_model"] == 0
+
+    def test_cost_of_never_raises(self):
+        class Broken:
+            def cost_analysis(self):
+                raise RuntimeError("nope")
+
+        assert cost_of(Broken()) == {"flops": 0.0, "bytes": 0.0}
+
+
+class TestPeakLookup:
+    def test_override_wins(self):
+        assert peak_flops_for("TPU v5e", override_tflops=2.0) == 2.0e12
+
+    def test_table_substring_match(self):
+        assert peak_flops_for("TPU v5e something") == PEAK_FLOPS["TPU v5e"]
+
+    def test_unknown_is_none_not_invented(self):
+        assert peak_flops_for("cpu") is None
+
+
+# -- runtime attribution ---------------------------------------------------
+
+def make_attr(**over):
+    cm = {"flops_per_step": 1e9, "hbm_bytes_per_step": 1e8,
+          "wire_bytes_data": 1_000_000, "wire_bytes_model": 0,
+          "wire_bytes_total": 1_000_000}
+    cm.update(over.pop("cost_model", {}))
+    kw = dict(device_kind="TPU v5e", n_devices=1)
+    kw.update(over)
+    return PerfAttribution(cm, **kw)
+
+
+class TestPerfAttribution:
+    def frac_sum(self, snap):
+        return (snap["perf_frac_compute"] + snap["perf_frac_comm"]
+                + snap["perf_frac_host"] + snap["perf_frac_input"])
+
+    def test_fractions_sum_to_one(self):
+        snap = make_attr().interval(wall_s=10.0, steps=100,
+                                    input_wait_s=2.0, device_wait_s=5.0)
+        assert self.frac_sum(snap) == pytest.approx(1.0, abs=2e-3)
+        assert snap["perf_frac_input"] == pytest.approx(0.2, abs=1e-3)
+
+    def test_device_share_splits_compute_vs_comm(self):
+        # per-step estimates on v5e: compute 1e9/197e12 ≈ 5.1us, comm
+        # 1e6/800e9 = 1.25us — the observed device wait splits ~80/20
+        snap = make_attr().interval(wall_s=1.0, steps=100,
+                                    device_wait_s=0.8)
+        assert snap["perf_frac_comm"] > 0.1
+        assert snap["perf_frac_compute"] > snap["perf_frac_comm"]
+        assert self.frac_sum(snap) == pytest.approx(1.0, abs=2e-3)
+
+    def test_no_peak_no_mfu_all_device_time_is_compute(self):
+        a = make_attr(device_kind="cpu")  # no peak/bw tables, no override
+        snap = a.interval(wall_s=1.0, steps=10, device_wait_s=0.5)
+        assert "perf_mfu" not in snap
+        assert snap["perf_frac_comm"] == 0.0
+        assert snap["perf_frac_compute"] == pytest.approx(0.5, abs=1e-3)
+
+    def test_mfu_formula(self):
+        a = make_attr(peak_tflops_override=1.0)  # 1 TFLOP/s peak
+        snap = a.interval(wall_s=1.0, steps=100)  # 100 x 1e9 flops / 1s
+        assert snap["perf_mfu"] == pytest.approx(0.1, abs=1e-3)
+        assert 0.0 < snap["perf_mfu"] <= 1.0
+        assert snap["perf_hbm_gbps"] == pytest.approx(10.0, rel=1e-3)
+
+    def test_overlong_waits_clamp_never_negative(self):
+        snap = make_attr().interval(wall_s=1.0, steps=10,
+                                    input_wait_s=5.0, device_wait_s=5.0)
+        assert snap["perf_frac_input"] == 1.0
+        assert snap["perf_frac_host"] == 0.0
+        assert self.frac_sum(snap) == pytest.approx(1.0, abs=2e-3)
+
+    def test_n_devices_scales_peak(self):
+        one = make_attr(peak_tflops_override=1.0, n_devices=1)
+        four = make_attr(peak_tflops_override=1.0, n_devices=4)
+        s1 = one.interval(wall_s=1.0, steps=100)
+        s4 = four.interval(wall_s=1.0, steps=100)
+        assert s1["perf_mfu"] == pytest.approx(4 * s4["perf_mfu"], rel=1e-3)
+
+    def test_producer_idle_is_slack_not_a_fraction(self):
+        snap = make_attr().interval(wall_s=1.0, steps=10,
+                                    producer_idle_s=0.7)
+        assert snap["perf_producer_idle_ms_per_step"] == pytest.approx(70.0)
+        assert self.frac_sum(snap) == pytest.approx(1.0, abs=2e-3)
+
+
+# -- goodput ledger --------------------------------------------------------
+
+class TestGoodputLedger:
+    def test_split_iteration_measured_first_remainder_productive(self, tmp_path):
+        led = GoodputLedger(tmp_path)
+        led.split_iteration(1.0, input_s=0.2, compile_s=0.3)
+        tot = led.totals()
+        assert tot["input_stall"] == pytest.approx(0.2)
+        assert tot["compile"] == pytest.approx(0.3)
+        assert tot["productive_step"] == pytest.approx(0.5)
+
+    def test_split_clamps_to_interval(self, tmp_path):
+        led = GoodputLedger(tmp_path)
+        led.split_iteration(1.0, input_s=0.8, save_s=0.8)
+        tot = led.totals()
+        assert tot["input_stall"] == pytest.approx(0.8)
+        assert tot["checkpoint_save"] == pytest.approx(0.2)  # clamped
+        assert tot["productive_step"] == 0.0
+        assert sum(tot.values()) == pytest.approx(1.0)
+
+    def test_unknown_bucket_lands_in_other(self, tmp_path):
+        led = GoodputLedger(tmp_path)
+        led.add("no_such_bucket", 2.0)
+        assert led.totals()["other"] == pytest.approx(2.0)
+
+    def test_flush_writes_schema(self, tmp_path):
+        led = GoodputLedger(tmp_path)
+        led.add("productive_step", 9.0)
+        led.add("compile", 1.0)
+        led.flush()
+        rec = json.loads((tmp_path / "goodput.json").read_text())
+        assert rec["goodput"] == pytest.approx(0.9)
+        assert set(BUCKETS) <= set(rec["buckets"])
+        assert rec["attempt"] == 1
+
+    def test_restart_accumulates_and_counts_downtime(self, tmp_path):
+        first = GoodputLedger(tmp_path)
+        first.add("productive_step", 10.0)
+        first.flush()
+        # the restarted attempt starts 30s after the last heartbeat:
+        # the gap is preemption downtime, bucketed `halted`
+        second = GoodputLedger(tmp_path, now=time.time() + 30.0)
+        second.add("productive_step", 5.0)
+        tot = second.totals()
+        assert second.attempt == 2
+        assert tot["productive_step"] == pytest.approx(15.0)
+        assert tot["halted"] == pytest.approx(30.0, abs=2.0)
+        second.flush()
+        rec = json.loads((tmp_path / "goodput.json").read_text())
+        assert rec["attempt"] == 2
+        assert rec["buckets"]["productive_step"] == pytest.approx(15.0)
+        assert len(rec["attempts_log"]) == 2
+
+    def test_completed_attempt_books_no_downtime(self, tmp_path):
+        """Resuming a FINISHED run with a larger budget days later is a
+        workflow, not a preemption: the completed marker suppresses the
+        halted gap that interrupted attempts book."""
+        first = GoodputLedger(tmp_path)
+        first.add("productive_step", 10.0)
+        first.completed = True  # the engine sets this at budget-reached
+        first.flush()
+        second = GoodputLedger(tmp_path, now=time.time() + 86400.0)
+        assert second.attempt == 2
+        assert second.totals()["halted"] == 0.0
+
+    def test_corrupt_ledger_starts_fresh(self, tmp_path):
+        (tmp_path / "goodput.json").write_text("{not json")
+        led = GoodputLedger(tmp_path)  # must not raise
+        assert led.attempt == 1
+
+    def test_rate_limited_flush(self, tmp_path):
+        led = GoodputLedger(tmp_path)
+        led.add("productive_step", 1.0)
+        led.flush(min_interval_s=3600.0)  # first write always lands
+        led.add("productive_step", 99.0)
+        led.flush(min_interval_s=3600.0)  # inside the window: skipped
+        rec = json.loads((tmp_path / "goodput.json").read_text())
+        assert rec["buckets"]["productive_step"] == pytest.approx(1.0)
+        led.flush()  # unconditional: the shutdown path
+        rec = json.loads((tmp_path / "goodput.json").read_text())
+        assert rec["buckets"]["productive_step"] == pytest.approx(100.0)
+
+
+# -- phase annotations -----------------------------------------------------
+
+class TestPhaseAnnotations:
+    def test_annotate_toggles(self):
+        from contextlib import nullcontext
+
+        from pytorch_ddp_template_tpu.utils.profiler import (
+            annotate, phase_annotations_enabled, set_phase_annotations,
+        )
+
+        assert phase_annotations_enabled()
+        assert isinstance(annotate("x"), jax.profiler.TraceAnnotation)
+        try:
+            set_phase_annotations(False)
+            assert isinstance(annotate("x"), nullcontext)
+            with annotate("x"):  # still a working context manager
+                pass
+        finally:
+            set_phase_annotations(True)
+
+    def test_named_scopes_reach_the_compiled_schedule(self):
+        """The decomposed-scan phase names must survive into the
+        compiled program's op metadata — that is what makes traces and
+        HLO dumps readable."""
+        from pytorch_ddp_template_tpu.parallel.schedule import (
+            PlainSchedule, decomposed_scan,
+        )
+
+        stacked = {"w": jnp.ones((4, 8, 8), jnp.float32)}
+
+        def apply_fn(w, y, k, extras):
+            return jnp.tanh(y @ w["w"])
+
+        def run(stacked, x):
+            return decomposed_scan(
+                PlainSchedule(), apply_fn, stacked, x, ()).sum()
+
+        x = jnp.ones((8,), jnp.float32)
+        text = jax.jit(jax.grad(run, argnums=1)).lower(
+            stacked, x).compile().as_text()
+        assert "sched_weights" in text
+        assert "sched_block_fwd" in text
+        assert "sched_block_bwd" in text
+
+
+# -- engine integration ----------------------------------------------------
+
+class TestEngineRetraceAccounting:
+    def test_note_dispatch_warns_on_midrun_retrace(self, monkeypatch):
+        """Satellite: a mid-run re-trace (shape/bucket change) must log
+        its duration instead of masquerading as one slow step, and the
+        duration must land in the pending `compile` bucket."""
+        from pytorch_ddp_template_tpu.train import engine
+
+        warned, infoed = [], []
+        monkeypatch.setattr(engine.log, "warning",
+                            lambda msg, *a: warned.append(msg))
+        monkeypatch.setattr(engine.log, "info",
+                            lambda msg, *a: infoed.append(msg))
+
+        class StepStub:
+            def __init__(self):
+                self.size = 0
+
+            def _cache_size(self):
+                return self.size
+
+        class Host:
+            pass
+
+        host = Host()
+        host.train_step = StepStub()
+        host._jit_cache_size = 0
+        host._pending = {"compile": 0.0, "checkpoint_save": 0.0,
+                         "eval": 0.0, "other": 0.0}
+
+        host.train_step.size = 1  # startup compile: info, no warning
+        engine.Trainer._note_dispatch(host, 0.5)
+        assert host._pending["compile"] == pytest.approx(0.5)
+        assert not warned and infoed
+        engine.Trainer._note_dispatch(host, 0.01)  # cached: no accrual
+        assert host._pending["compile"] == pytest.approx(0.5)
+        host.train_step.size = 2  # mid-run retrace: warn + accrue
+        engine.Trainer._note_dispatch(host, 0.7)
+        assert host._pending["compile"] == pytest.approx(1.2)
+        assert warned and "re-traced" in warned[0]
+
+    def test_wrapped_step_without_cache_size_is_ignored(self):
+        from pytorch_ddp_template_tpu.train.engine import Trainer
+
+        class Host:
+            pass
+
+        host = Host()
+        host.train_step = lambda *a: None  # bench/test injector wrappers
+        host._jit_cache_size = 0
+        host._pending = {"compile": 0.0}
+        Trainer._note_dispatch(host, 1.0)  # must not raise
+        assert host._pending["compile"] == 0.0
+
+
+def make_trainer(out_dir, **overrides):
+    from pytorch_ddp_template_tpu.config import TrainingConfig
+    from pytorch_ddp_template_tpu.models import build
+    from pytorch_ddp_template_tpu.runtime import init as rt_init
+    from pytorch_ddp_template_tpu.train.engine import Trainer
+
+    cfg = TrainingConfig(**{
+        "model": "mlp", "mesh": "data:8",
+        "per_device_train_batch_size": 4, "dataset_size": 512,
+        "max_steps": 8, "logging_steps": 4, "save_steps": 0,
+        "resume": False, "warmup_steps": 0, "max_grad_norm": 1000.0,
+        "output_dir": str(out_dir), **overrides})
+    ctx = rt_init(cfg)
+    task, ds = build(cfg.model, cfg, mesh=ctx.mesh)
+    return Trainer(cfg, ctx, task, ds)
+
+
+class TestEngineAttribution:
+    def test_perf_report_emits_attribution_and_goodput(self, tmp_path):
+        """--perf_report end to end on the production loop: the progress
+        record carries MFU + the fractional breakdown (summing to ~1)
+        and producer_idle_ms (satellite 2), and goodput.json lands with
+        the full bucket set."""
+        t = make_trainer(tmp_path, perf_report=True, peak_tflops=1e-4)
+        t.train()
+        assert t.perf is not None
+        assert t.perf.cost_model["flops_per_step"] > 0
+
+        recs = [json.loads(l) for l in
+                (tmp_path / "metrics.jsonl").read_text().splitlines()]
+        prog = [r for r in recs if "perf_frac_compute" in r]
+        assert prog, "no attribution fields reached the progress record"
+        last = prog[-1]
+        frac_sum = (last["perf_frac_compute"] + last["perf_frac_comm"]
+                    + last["perf_frac_host"] + last["perf_frac_input"])
+        assert frac_sum == pytest.approx(1.0, abs=2e-3)
+        assert 0.0 < last["perf_mfu"] <= 1.0
+        assert "producer_idle_ms" in last and "input_wait_ms" in last
+
+        gp = json.loads((tmp_path / "goodput.json").read_text())
+        assert set(BUCKETS) <= set(gp["buckets"])
+        assert gp["buckets"]["compile"] > 0  # startup compile accounted
+        assert gp["goodput"] is not None
+
+    def test_plain_run_still_writes_goodput(self, tmp_path):
+        """The ledger is NOT gated on --perf_report: every training job
+        accounts its wall-clock."""
+        t = make_trainer(tmp_path)
+        t.train()
+        gp = json.loads((tmp_path / "goodput.json").read_text())
+        assert gp["buckets"]["productive_step"] > 0
+        # no attribution though: the flag was off
+        recs = [json.loads(l) for l in
+                (tmp_path / "metrics.jsonl").read_text().splitlines()]
+        assert not any("perf_frac_compute" in r for r in recs)
+
+
+class TestGoodputSurvivesRestart:
+    def test_cli_kill_and_resume_accumulates(self, tmp_path):
+        """Acceptance: a restarted run's ledger includes the prior
+        attempt's buckets, pinned at the CLI level — run to step 4, stop
+        (the preemption-shaped exit: checkpoint on disk, ledger on
+        disk), rerun the SAME command with a larger budget and
+        auto-resume."""
+        import ddp
+
+        out = tmp_path / "run"
+        args = ["--model", "mlp", "--mesh", "data:8",
+                "--per_device_train_batch_size", "4",
+                "--dataset_size", "256", "--logging_steps", "2",
+                "--save_steps", "4", "--seed", "7",
+                "--output_dir", str(out)]
+        assert ddp.main(args + ["--max_steps", "4"]) == 0
+        first = json.loads((out / "goodput.json").read_text())
+        assert first["attempt"] == 1
+        assert first["buckets"]["compile"] > 0
+
+        assert ddp.main(args + ["--max_steps", "8"]) == 0
+        second = json.loads((out / "goodput.json").read_text())
+        assert second["attempt"] == 2
+        assert len(second["attempts_log"]) == 2
+        # cumulative: every prior bucket is included in the new totals
+        for bucket, val in first["buckets"].items():
+            assert second["buckets"][bucket] >= val - 1e-6, bucket
+        # and the resumed attempt did REAL new work on top
+        assert (second["buckets"]["productive_step"]
+                > first["buckets"]["productive_step"])
+        # the resume itself was accounted (restore bucket grew)
+        assert second["buckets"]["restore"] > first["buckets"]["restore"]

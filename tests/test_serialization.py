@@ -1,0 +1,140 @@
+"""First direct unit tests for ``utils.serialization.json_sanitize``
+(added r12, exercised only through sentry bundles until now; r13 extends
+it to device arrays, nested containers and an unserialisable-object
+fallback). The contract under test: whatever goes in, ``json.dumps(...,
+allow_nan=False)`` must accept what comes out, and non-finite spellings
+must survive in ``_repr`` siblings."""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_ddp_template_tpu.utils.serialization import json_sanitize
+
+
+def dumps(record):
+    """The enforcement the writers apply: raises on any non-finite that
+    dodged the sanitiser."""
+    return json.dumps(json_sanitize(record), allow_nan=False)
+
+
+class TestNonFiniteScalars:
+    def test_nan_becomes_null_with_repr(self):
+        out = json_sanitize({"loss": float("nan")})
+        assert out["loss"] is None
+        assert out["loss_repr"] == "nan"
+
+    def test_inf_spellings_preserved(self):
+        out = json_sanitize({"a": float("inf"), "b": float("-inf")})
+        assert out["a"] is None and out["a_repr"] == "inf"
+        assert out["b"] is None and out["b_repr"] == "-inf"
+
+    def test_finite_values_untouched(self):
+        rec = {"f": 1.5, "i": 3, "s": "x", "b": True, "n": None}
+        assert json_sanitize(rec) == rec
+
+    def test_dumps_accepts_everything(self):
+        text = dumps({"loss": float("nan"), "grad": float("inf"),
+                      "ok": 1.0})
+        parsed = json.loads(text)  # a COMPLIANT parser must accept it
+        assert parsed["loss"] is None and parsed["ok"] == 1.0
+
+
+class TestLists:
+    def test_flat_list_with_nan(self):
+        out = json_sanitize({"v": [1.0, float("nan"), 2.0]})
+        assert out["v"] == [1.0, None, 2.0]
+        assert out["v_repr"] == "[1.0, nan, 2.0]"
+
+    def test_clean_list_gets_no_repr(self):
+        out = json_sanitize({"v": [1.0, 2.0]})
+        assert out["v"] == [1.0, 2.0]
+        assert "v_repr" not in out
+
+    def test_nested_list_stays_parseable(self):
+        out = json_sanitize({"m": [[1.0, float("nan")], [2.0, 3.0]]})
+        assert out["m"] == [[1.0, None], [2.0, 3.0]]
+        json.loads(dumps({"m": [[1.0, float("nan")]]}))
+
+
+class TestNestedDicts:
+    def test_recursion(self):
+        out = json_sanitize({"outer": {"inner": float("nan"), "k": 1}})
+        assert out["outer"]["inner"] is None
+        assert out["outer"]["inner_repr"] == "nan"
+        assert out["outer"]["k"] == 1
+
+    def test_dict_inside_list(self):
+        out = json_sanitize({"l": [{"x": float("inf")}]})
+        assert out["l"][0]["x"] is None
+        assert out["l"][0]["x_repr"] == "inf"
+
+
+class TestDeviceArrays:
+    """The triage/ledger paths hand whole device values to the sanitiser
+    (the r13 contract): 0-d arrays become numbers, vectors become lists,
+    non-finite elements still sanitise."""
+
+    def test_numpy_scalar(self):
+        out = json_sanitize({"x": np.float32(2.5)})
+        assert out["x"] == 2.5
+        json.loads(dumps({"x": np.float32(2.5)}))
+
+    def test_numpy_nan_scalar(self):
+        out = json_sanitize({"x": np.float64("nan")})
+        assert out["x"] is None and out["x_repr"] == "nan"
+
+    def test_jax_scalar_and_vector(self):
+        out = json_sanitize({"s": jnp.float32(1.5),
+                             "v": jnp.asarray([1.0, 2.0])})
+        assert out["s"] == 1.5
+        assert out["v"] == [1.0, 2.0]
+
+    def test_jax_vector_with_nonfinite(self):
+        v = jnp.asarray([1.0, float("nan"), float("inf")])
+        out = json_sanitize({"v": v})
+        assert out["v"] == [1.0, None, None]
+        assert "nan" in out["v_repr"] and "inf" in out["v_repr"]
+        json.loads(dumps({"v": v}))
+
+    def test_numpy_matrix_nests(self):
+        out = json_sanitize({"m": np.ones((2, 2), np.float32)})
+        assert out["m"] == [[1.0, 1.0], [1.0, 1.0]]
+
+
+class TestUnserialisableFallback:
+    def test_object_becomes_repr(self):
+        class Opaque:
+            def __repr__(self):
+                return "<opaque thing>"
+
+        out = json_sanitize({"o": Opaque()})
+        assert out["o"] == "<opaque thing>"
+        json.loads(dumps({"o": Opaque()}))
+
+    def test_object_inside_list(self):
+        class Opaque:
+            def __repr__(self):
+                return "<elem>"
+
+        out = json_sanitize({"l": [1, Opaque()]})
+        assert out["l"] == [1, "<elem>"]
+
+    def test_bool_is_not_mistaken_for_int(self):
+        out = json_sanitize({"flag": True})
+        assert out["flag"] is True
+
+
+class TestWriterIntegration:
+    def test_metrics_writer_path_round_trips(self, tmp_path):
+        """The MetricsWriter's exact call pattern: sanitize + allow_nan
+        enforcement on a record carrying the sentry's worst case."""
+        rec = {"step": 3, "loss": float("nan"),
+               "per_layer_grad_norm": [1.0, float("inf")]}
+        parsed = json.loads(dumps(rec))
+        assert parsed["step"] == 3
+        assert parsed["loss"] is None
+        assert parsed["per_layer_grad_norm"] == [1.0, None]
+        assert parsed["loss_repr"] == "nan"  # the spelling survives
+        assert parsed["per_layer_grad_norm_repr"] == "[1.0, inf]"

@@ -66,9 +66,14 @@ def main() -> None:
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     import bench
-    from bench import cost_of, init_devices
+    from bench import init_devices
 
     init_devices()  # honours BENCH_CPU=1 and guards against a dead tunnel
+    # the one shared copy of the cost/peak helpers (obs/attribution.py,
+    # r13) — bench.py re-exports them from the same home
+    from pytorch_ddp_template_tpu.obs.attribution import (
+        cost_of, peak_flops_for,
+    )
     from pytorch_ddp_template_tpu.config import TrainingConfig
     from pytorch_ddp_template_tpu.models import build
     from pytorch_ddp_template_tpu.parallel import shard_tree
@@ -132,7 +137,7 @@ def main() -> None:
         state, batch).compile()
 
     kind = devices[0].device_kind
-    peak = next((v for k, v in bench.PEAK_FLOPS.items() if k in kind), None)
+    peak = peak_flops_for(kind)
     t_step = None
 
     # the step donates its input state; rethread it every call
