@@ -201,6 +201,51 @@ class TrainingConfig:
     #                           PEAK_FLOPS spec table (required for
     #                           hardware the table does not know — MFU
     #                           is omitted rather than invented)
+    fleet: bool = False  # fleet watchtower (obs/fleet.py): periodic
+    #                      cross-host exchange of host-side signals
+    #                      (step wall, input/host/device-wait fractions,
+    #                      producer idle, goodput deltas, anomaly state)
+    #                      at the perf/logging cadence ON the telemetry
+    #                      drain thread — never the hot loop. Rank-0
+    #                      logs a min/median/max fleet table; a host
+    #                      slower than the fleet median by more than
+    #                      --straggler_threshold for
+    #                      --straggler_windows consecutive windows
+    #                      feeds the sentry as a `straggler` trigger
+    #                      (triage bundle names the host). Degenerate
+    #                      (this host only) on single-process runs
+    straggler_threshold: float = 0.25  # relative step-wall excess over
+    #                                    the fleet median that marks a
+    #                                    window suspect (0.25 = 25%)
+    straggler_windows: int = 3  # consecutive suspect windows before the
+    #                             straggler verdict fires
+    status_port: int = 0  # opt-in live status endpoint (obs/server.py):
+    #                       serve /status (JSON snapshot: latest
+    #                       progress/perf records, goodput, sentry,
+    #                       fleet table), /metrics (Prometheus text
+    #                       format, tpuddp_ gauges) and /healthz on
+    #                       this port from a background daemon thread;
+    #                       0 = off; -1 = bind an ephemeral port (the
+    #                       actual port is logged and exposed as
+    #                       Trainer.status.port — tests/bench, where a
+    #                       probed "free" port could be taken back in
+    #                       the build/compile window before bind).
+    #                       Closed in the engine's crash-safe shutdown
+    #                       path
+    status_host: str = "0.0.0.0"  # interface --status_port binds;
+    #                               default all interfaces (a fleet's
+    #                               Prometheus scrapes cross-host, the
+    #                               node-exporter convention) — pass
+    #                               127.0.0.1 to keep the endpoint
+    #                               loopback-only (it serves the full
+    #                               config snapshot, unauthenticated)
+    regression_pct: float = 20.0  # perf-regression tripwire band
+    #                               (obs/regression.py): a restarted
+    #                               run whose steady step wall is
+    #                               slower (or MFU lower) than the
+    #                               prior attempt's perf_baseline.json
+    #                               by more than this percentage WARNs
+    #                               with the delta
     hlo_report: bool = False  # compile the train step ahead of the loop
     #                           and write an HLO schedule report
     #                           (obs/hlo_report.py) to
@@ -277,6 +322,32 @@ class TrainingConfig:
             raise ValueError(
                 f"--peak_tflops must be >= 0, got {self.peak_tflops} "
                 "(0 = use the obs/attribution.py spec table)"
+            )
+        if self.status_port < -1 or self.status_port > 65535:
+            raise ValueError(
+                f"--status_port must be in [-1, 65535], got "
+                f"{self.status_port} (0 = off, -1 = ephemeral)"
+            )
+        if self.straggler_threshold <= 0:
+            raise ValueError(
+                f"--straggler_threshold must be > 0, got "
+                f"{self.straggler_threshold} (a relative excess over the "
+                "fleet median, e.g. 0.25 = 25%)"
+            )
+        if self.straggler_windows < 1:
+            raise ValueError(
+                f"--straggler_windows must be >= 1, got "
+                f"{self.straggler_windows}"
+            )
+        if self.regression_pct <= 0:
+            raise ValueError(
+                f"--regression_pct must be > 0, got {self.regression_pct}"
+            )
+        if self.fleet and not (self.logging_steps or self.perf_every):
+            raise ValueError(
+                "--fleet exchanges at the perf/logging cadence, but both "
+                "--logging_steps and --perf_every are 0 — set one of them "
+                "or drop --fleet (a cadence-less watchtower never fires)"
             )
         if self.anomaly not in ("off", "warn", "halt"):
             raise ValueError(
@@ -644,6 +715,45 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "(0 = the obs/attribution.py spec table; on "
                         "hardware the table does not know, MFU is "
                         "omitted unless this is set).")
+    p.add_argument("--fleet", action="store_true",
+                   help="Fleet watchtower (obs/fleet.py): exchange each "
+                        "host's host-side signals (step wall, "
+                        "input/host/device-wait fractions, producer "
+                        "idle, goodput deltas, anomaly state) across "
+                        "processes at the perf/logging cadence, on the "
+                        "telemetry drain thread. Rank 0 logs a "
+                        "min/median/max fleet table; a sustained "
+                        "straggler feeds the sentry as a `straggler` "
+                        "trigger whose triage bundle names the host. "
+                        "Single-process runs degrade to a one-host "
+                        "table.")
+    p.add_argument("--straggler_threshold", type=float, default=0.25,
+                   help="Relative step-wall excess over the fleet median "
+                        "that marks a window suspect (0.25 = 25%%).")
+    p.add_argument("--straggler_windows", type=int, default=3,
+                   help="Consecutive suspect windows before the "
+                        "straggler verdict fires.")
+    p.add_argument("--status_port", type=int, default=0,
+                   help="Serve /status (JSON), /metrics (Prometheus "
+                        "text format) and /healthz on this port from a "
+                        "background thread (obs/server.py): the latest "
+                        "drained progress/perf records, goodput "
+                        "summary, sentry state and fleet table, live. "
+                        "0 = off; -1 = ephemeral port (logged at "
+                        "startup). Closed in the engine's crash-safe "
+                        "shutdown path.")
+    p.add_argument("--status_host", type=str, default="0.0.0.0",
+                   help="Interface the --status_port endpoint binds. "
+                        "Default all interfaces (fleet Prometheus "
+                        "scrapes cross-host); pass 127.0.0.1 for a "
+                        "loopback-only endpoint — it serves the full "
+                        "config snapshot, unauthenticated.")
+    p.add_argument("--regression_pct", type=float, default=20.0,
+                   help="Perf-regression tripwire band: a restarted run "
+                        "whose steady step wall is slower (or MFU "
+                        "lower) than the prior attempt's "
+                        "perf_baseline.json by more than this "
+                        "percentage logs a WARNING with the delta.")
     p.add_argument("--hlo_report", action="store_true",
                    help="Compile the train step ahead of the loop and "
                         "write obs/hlo_report.py's schedule report to "

@@ -1,7 +1,7 @@
-"""Observability subsystem (rounds 12-13): the training loop watching
+"""Observability subsystem (rounds 12-14): the training loop watching
 itself.
 
-Five coordinated pieces:
+Eight coordinated pieces:
 
 - :mod:`.health` — in-step device-side health scalars (param/update
   norms, non-finite counts, per-layer grad norms, EF-residual norm)
@@ -19,7 +19,17 @@ Five coordinated pieces:
 - :mod:`.goodput` — the wall-clock ledger bucketing every second of the
   run (productive / compile / checkpoint / restore / input-stall /
   halted), persisted to ``goodput.json`` and accumulated across
-  restarts.
+  restarts;
+- :mod:`.fleet` — the r14 fleet watchtower: periodic cross-host
+  exchange of host-side signals at the logging cadence (on the
+  telemetry drain thread), min/median/max fleet tables, and the
+  straggler verdict that feeds the sentry as a ``straggler`` trigger;
+- :mod:`.server` — the opt-in ``--status_port`` HTTP endpoint:
+  ``/status`` (JSON), ``/metrics`` (Prometheus text format),
+  ``/healthz``;
+- :mod:`.regression` — the per-attempt steady-state perf fingerprint
+  (``perf_baseline.json``) compared on restore, WARNing when a
+  restarted/resharded run comes back out of band.
 
 Import discipline: :mod:`.hlo_report` is pure stdlib and must STAY
 reachable without jax installed/imported (the ``parallel/`` delegates and
@@ -42,8 +52,27 @@ _EXPORTS = {
         "peak_flops_for",
         "static_cost_model",
     ),
+    "fleet": (
+        "FLEET_WIRE_KEYS",
+        "FleetMonitor",
+        "decode_rows",
+        "encode_window",
+    ),
     "goodput": ("BUCKETS", "GoodputLedger"),
     "health": ("HEALTH_KEYS", "health_metrics"),
+    "regression": (
+        "PerfBaseline",
+        "compare_fingerprints",
+        "config_signature",
+        "make_fingerprint",
+    ),
+    "server": (
+        "PROM_PREFIX",
+        "StatusServer",
+        "prom_escape",
+        "prom_name",
+        "prometheus_lines",
+    ),
     "hlo_report": (
         "GATHER_FAMILY",
         "RING_FAMILY",

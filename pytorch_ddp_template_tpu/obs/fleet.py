@@ -1,0 +1,338 @@
+"""Fleet watchtower: cross-host straggler attribution over host-side signals.
+
+The r12 flight recorder and r13 step-time X-ray made a *single host*
+self-diagnosing, but every signal they produce is host-local: on a
+multi-host pod the operational questions are "which host is slow right
+now?" and "is one host quietly degrading?". Production LLM-training
+experience (MegaScale, NSDI'24) puts stragglers and silent per-host
+degradation at the top of the lost-goodput table, and the fix is always
+the same shape: exchange each host's cheap host-side health numbers at
+a low cadence, aggregate them rank-aware, and name the outlier.
+
+This module is that exchange, sized for this engine:
+
+- **Window** — once per perf/logging interval the engine packs its
+  *host-side* signals (step wall, input/device-wait/host wall fractions,
+  producer idle, goodput bucket deltas, anomaly state) into a flat float
+  record keyed by :data:`FLEET_WIRE_KEYS`. Everything is host float math
+  the loop already computed — nothing touches a device on the hot path.
+- **Exchange** — :meth:`FleetMonitor.observe` runs on the r6
+  ``AsyncTelemetry`` drain thread (``kind="fleet"`` records route here,
+  never to the JSONL writer), encodes the window as a fixed-size vector
+  and all-gathers it across processes
+  (``jax.experimental.multihost_utils.process_allgather``; a
+  single-process run skips the collective entirely, so the degenerate
+  case costs a dict copy). Every process emits at the same cadence —
+  the loop's logging boundary — so the collective is symmetric by
+  construction. A transport failure degrades to the local row and logs
+  once: the watchtower must never cost the run it watches.
+- **Aggregation** — the fleet table: per-signal min/median/max plus the
+  per-host rows, kept as :attr:`FleetMonitor.latest_table` (served by
+  ``obs/server.py`` under ``/status`` and ``/metrics``) and logged on
+  rank 0 at a gentle cadence.
+- **Straggler verdict** — a host whose ``step_wall_ms`` exceeds the
+  fleet median by more than ``threshold`` (relative) for ``windows``
+  consecutive exchanges is named a straggler. The verdict feeds the r12
+  sentry as a new ``kind="straggler"`` trigger
+  (:meth:`obs.sentry.AnomalySentry.external_trigger`), so the standard
+  triage bundle lands in ``flight_records/`` with the offending host in
+  ``trigger.json``. A flagged host re-arms only after it returns under
+  the threshold (one verdict per degradation episode, not one per
+  window).
+
+Threading contract: ``observe`` runs on the telemetry drain thread; the
+table handoff is a single attribute rebind (read by the status server
+and the engine without a lock — dict replacement is atomic in CPython).
+"""
+
+from __future__ import annotations
+
+import statistics
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+from ..utils import get_logger
+from ..utils.dist import process_count, process_index
+
+log = get_logger(__name__)
+
+#: the per-window host signals on the wire, in vector order (the
+#: allgather ships one float32 per key; keep appends at the END so a
+#: mixed-version fleet degrades to garbage-in-new-keys, not misaligned
+#: old ones)
+FLEET_WIRE_KEYS = (
+    "step",               # global step of the window boundary
+    "step_wall_ms",       # interval wall / steps — THE straggler signal
+    "frac_input",         # fraction of wall blocked on the loader
+    "frac_device",        # fraction of wall in the dispatch-depth fence
+    "frac_host",          # remainder: host-side Python between dispatches
+    "input_wait_ms",      # per-step loader block
+    "producer_idle_ms",   # per-step prefetch slack
+    "gp_productive_s",    # goodput ledger delta: productive seconds
+    "gp_wall_s",          # goodput ledger delta: total seconds
+    "anomaly",            # 1.0 when this host's sentry has triggered
+)
+
+#: signals the fleet table summarises with min/median/max (step is an
+#: identity column; anomaly is summarised as a count)
+SUMMARY_KEYS = tuple(k for k in FLEET_WIRE_KEYS
+                     if k not in ("step", "anomaly"))
+
+
+def encode_window(window: dict[str, Any]) -> np.ndarray:
+    """Pack one host window into the fixed-order float32 wire vector
+    (missing keys ship as 0.0 — a host that has no perf data yet must
+    not stall the fleet's collective)."""
+    return np.asarray([float(window.get(k, 0.0) or 0.0)
+                       for k in FLEET_WIRE_KEYS], dtype=np.float32)
+
+
+def decode_rows(rows: np.ndarray) -> list[dict[str, float]]:
+    """Unpack the allgathered ``(n_hosts, len(FLEET_WIRE_KEYS))`` matrix
+    back into per-host records (extra columns from a newer peer are
+    ignored; short rows zero-fill)."""
+    out: list[dict[str, float]] = []
+    arr = np.atleast_2d(np.asarray(rows, dtype=np.float64))
+    for host, row in enumerate(arr):
+        rec: dict[str, float] = {"host": float(host)}
+        for i, k in enumerate(FLEET_WIRE_KEYS):
+            rec[k] = float(row[i]) if i < row.shape[0] else 0.0
+        out.append(rec)
+    return out
+
+
+#: wall-clock bound on waiting for one peer's window in the exchange —
+#: a wedged peer degrades THIS host to a partial table (its own row
+#: substituted), it must never wedge the drain thread with it
+KV_TIMEOUT_MS = 10_000
+
+_kv_round = 0
+
+
+def _default_exchange(vec: np.ndarray) -> np.ndarray:
+    """Share this host's wire vector across processes via the
+    ``jax.distributed`` coordination-service KV store — deliberately
+    NOT a device collective: this runs on the telemetry drain thread,
+    and issuing an XLA collective there would interleave with the
+    train loop's own collectives in a thread-scheduling-dependent
+    order across hosts (XLA:TPU requires every host to enqueue
+    cross-host computations identically — a mismatched order deadlocks
+    the very run the watchtower exists to watch). The KV store is the
+    same gRPC side channel orbax and the distributed init use; it
+    never touches a device. Single-process fleets are just this
+    host's row (no jax.distributed involved at all).
+
+    Exchange protocol: round-numbered keys (every host emits at the
+    same cadence, so round counters agree), set-then-gather with a
+    bounded per-peer wait — a missing/laggard peer's row degrades to
+    this host's own values rather than stalling; rounds older than the
+    previous one are deleted best-effort so the store stays bounded."""
+    if process_count() == 1:
+        return vec[None, :]
+    global _kv_round
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    if client is None:
+        raise RuntimeError("jax.distributed client not initialised")
+    me = process_index()
+    n = process_count()
+    rnd = _kv_round
+    _kv_round += 1
+    payload = ",".join(repr(float(x)) for x in vec)
+    client.key_value_set(f"obs_fleet/{rnd}/{me}", payload)
+    rows = []
+    for peer in range(n):
+        if peer == me:
+            rows.append(vec)
+            continue
+        try:
+            raw = client.blocking_key_value_get(
+                f"obs_fleet/{rnd}/{peer}", KV_TIMEOUT_MS)
+            vals = [float(x) for x in raw.split(",")]
+            # normalise to THIS version's width before stacking: a
+            # mixed-version fleet (rolling upgrade appended keys) must
+            # degrade to zero-filled/ignored columns, not a ValueError
+            # from np.stack that permanently benches the exchange
+            row = np.zeros(vec.shape[0], dtype=np.float32)
+            k = min(len(vals), vec.shape[0])
+            row[:k] = vals[:k]
+            rows.append(row)
+        except Exception:  # noqa: BLE001 - a laggard peer degrades to
+            #               this host's row, never a stalled drain
+            rows.append(vec)
+    if rnd >= 2:  # bounded store: drop the round before last
+        try:
+            client.key_value_delete(f"obs_fleet/{rnd - 2}/")
+        except Exception:  # noqa: BLE001 - cleanup is best-effort
+            pass
+    return np.stack(rows)
+
+
+class FleetMonitor:
+    """Aggregate per-host windows into a fleet table + straggler verdict.
+
+    ``exchange`` is injectable (tests and the bench's injected-straggler
+    leg fake a multi-host feed by returning extra rows); the default is
+    the real cross-process allgather. ``on_straggler(step, verdict)``
+    fires ONCE per degradation episode, on the drain thread — the engine
+    points it at the sentry's external trigger.
+    """
+
+    def __init__(self, *, threshold: float = 0.25, windows: int = 3,
+                 exchange: Callable[[np.ndarray], np.ndarray] | None = None,
+                 on_straggler: Callable[[int, dict[str, Any]], None] | None
+                 = None):
+        if threshold <= 0:
+            raise ValueError(f"straggler threshold must be > 0, got "
+                             f"{threshold}")
+        if windows < 1:
+            raise ValueError(f"straggler windows must be >= 1, got "
+                             f"{windows}")
+        self.threshold = float(threshold)
+        self.windows = int(windows)
+        self._exchange = exchange or _default_exchange
+        self.on_straggler = on_straggler
+        #: most recent aggregated table (drain thread writes, status
+        #: server / engine read — whole-dict rebind, no partial state)
+        self.latest_table: dict[str, Any] | None = None
+        self._suspect: dict[int, int] = {}   # host -> consecutive windows
+        self._flagged: set[int] = set()      # named stragglers, re-armed
+        #                                      when they recover
+        self._exchange_failed = False
+        self.exchanges = 0
+
+    # -- drain-thread side -------------------------------------------------
+    def observe(self, step: int, window: dict[str, Any]) -> None:
+        """Feed this host's window (telemetry ``kind="fleet"`` route);
+        exchanges, aggregates, detects. Never raises."""
+        try:
+            vec = encode_window(window)
+            try:
+                rows = self._exchange(vec)
+            except Exception:  # noqa: BLE001 - transport down ≠ run down
+                if not self._exchange_failed:
+                    self._exchange_failed = True
+                    log.exception(
+                        "fleet exchange failed; watching this host only "
+                        "(logged once)")
+                rows = vec[None, :]
+            hosts = decode_rows(rows)
+            table = self.aggregate(hosts, step=int(step))
+            self.exchanges += 1
+            verdicts = self._detect(table)
+            # the table's headline carries the slowest CURRENTLY-flagged
+            # host (not only newly-confirmed verdicts: an hour-long
+            # episode must read as a straggler on every scrape, not just
+            # the confirmation window), with this window's numbers
+            table["straggler"] = self._headline(table)
+            self.latest_table = table
+            if self.on_straggler is not None:
+                # every newly confirmed host gets its own verdict (two
+                # hosts behind one sick switch both deserve naming)
+                for verdict in verdicts:
+                    self.on_straggler(int(step), verdict)
+        except Exception:  # noqa: BLE001 - the watchtower must never
+            #               kill the telemetry drain
+            log.exception("fleet window dropped")
+
+    # -- pure aggregation (unit-testable without any transport) ------------
+    def aggregate(self, hosts: list[dict[str, float]], *,
+                  step: int = 0) -> dict[str, Any]:
+        """The fleet table over per-host rows: min/median/max per signal
+        plus the rows themselves and the anomaly count."""
+        table: dict[str, Any] = {
+            "step": int(step),
+            "time": time.time(),
+            "n_hosts": len(hosts),
+            "this_host": process_index(),
+            "hosts": [dict(h) for h in hosts],
+            "signals": {},
+            "anomaly_hosts": [int(h["host"]) for h in hosts
+                              if h.get("anomaly", 0.0) > 0],
+            "straggler": None,
+        }
+        for key in SUMMARY_KEYS:
+            vals = [float(h.get(key, 0.0)) for h in hosts]
+            table["signals"][key] = {
+                "min": min(vals),
+                "median": statistics.median(vals),
+                "max": max(vals),
+            }
+        return table
+
+    def _detect(self, table: dict[str, Any]) -> list[dict[str, Any]]:
+        """Straggler rule: ``step_wall_ms > median * (1 + threshold)``
+        for ``windows`` consecutive exchanges — one verdict PER newly
+        confirmed host (a degraded switch can make two hosts sick at
+        once; naming only the slowest would silently suppress the
+        other for its whole episode). Needs >= 3 hosts for a
+        meaningful median (with 2, the median straddles both and a
+        slow pair blames an innocent); a smaller fleet never fires.
+        Returns [] when nothing newly confirmed."""
+        hosts = table["hosts"]
+        if len(hosts) < 3:
+            return []
+        med = table["signals"]["step_wall_ms"]["median"]
+        if med <= 0:
+            return []
+        bar = med * (1.0 + self.threshold)
+        verdicts: list[dict[str, Any]] = []
+        for h in hosts:
+            hid = int(h["host"])
+            if h.get("step_wall_ms", 0.0) > bar:
+                self._suspect[hid] = self._suspect.get(hid, 0) + 1
+                if (self._suspect[hid] >= self.windows
+                        and hid not in self._flagged):
+                    self._flagged.add(hid)
+                    verdicts.append({
+                        "host": hid,
+                        "step_wall_ms": round(h["step_wall_ms"], 3),
+                        "fleet_median_ms": round(med, 3),
+                        "excess_pct": round(
+                            100.0 * (h["step_wall_ms"] / med - 1.0), 1),
+                        "threshold_pct": round(100.0 * self.threshold, 1),
+                        "consecutive_windows": self._suspect[hid],
+                    })
+            else:
+                # back under the bar: reset the streak AND re-arm the
+                # flag — the next sustained episode is a new verdict
+                self._suspect[hid] = 0
+                self._flagged.discard(hid)
+        return verdicts
+
+    def _headline(self, table: dict[str, Any]) -> dict[str, Any] | None:
+        """The table's ``straggler`` slot: the slowest currently-flagged
+        host with THIS window's numbers — stays set for the whole
+        degradation episode (scrapers alert on it), None when no host
+        is flagged."""
+        flagged = [h for h in table["hosts"]
+                   if int(h["host"]) in self._flagged]
+        if not flagged:
+            return None
+        med = table["signals"]["step_wall_ms"]["median"]
+        worst = max(flagged, key=lambda h: h.get("step_wall_ms", 0.0))
+        hid = int(worst["host"])
+        return {
+            "host": hid,
+            "step_wall_ms": round(worst.get("step_wall_ms", 0.0), 3),
+            "fleet_median_ms": round(med, 3),
+            "excess_pct": round(
+                100.0 * (worst.get("step_wall_ms", 0.0) / med - 1.0), 1)
+            if med > 0 else 0.0,
+            "threshold_pct": round(100.0 * self.threshold, 1),
+            "consecutive_windows": self._suspect.get(hid, 0),
+        }
+
+    # -- status-server side ------------------------------------------------
+    def state(self) -> dict[str, Any]:
+        """JSON-ready snapshot for ``/status``."""
+        return {
+            "exchanges": self.exchanges,
+            "threshold": self.threshold,
+            "windows": self.windows,
+            "degraded_to_local": self._exchange_failed,
+            "table": self.latest_table,
+        }

@@ -1,0 +1,188 @@
+"""Perf-regression tripwires: a per-attempt steady-state fingerprint.
+
+Goodput (r13) answers "how much wall-clock trained?"; this answers
+"did the run come back *slower* than it used to be?" — the question a
+restart (new jax wheel, reshard, different host pool) silently changes
+the answer to. Two pieces:
+
+- :class:`PerfBaseline` — at the end of every attempt the engine writes
+  ``<output_dir>/perf_baseline.json`` next to ``goodput.json``: the
+  steady-state step-wall percentiles (from the honest ``StepTimer`` —
+  side-work intervals already discarded), rolling MFU and wire budget
+  when ``--perf_report`` produced them, the host fraction, and a config
+  signature (mesh/model/overlap flags/batch). On restore the NEXT
+  attempt loads the prior fingerprint and, once its own timer has
+  enough steady samples, compares: a step wall slower (or MFU lower)
+  than the prior attempt by more than ``--regression_pct`` logs one
+  WARNING per regressed signal with the delta — and names a config
+  change when the signature differs (a resharded run that got slower is
+  information, not noise).
+- ``tools/bench_diff.py`` (the CLI sibling) applies the same
+  out-of-band rule to ``bench_records/*.jsonl`` files, turning the
+  committed records into executable tripwires.
+
+Comparisons are direction-aware (:data:`DIRECTIONS`): step walls
+regress upward, MFU/goodput regress downward. Signals missing on
+either side are skipped — a baseline written without ``--perf_report``
+still guards the step wall.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Any
+
+from ..utils import get_logger, is_main_process
+from ..utils.serialization import json_sanitize
+
+log = get_logger(__name__)
+
+FILENAME = "perf_baseline.json"
+
+#: compared fingerprint signals -> which direction is a regression
+DIRECTIONS = {
+    "step_time_p50_ms": "higher_is_worse",
+    "step_time_p90_ms": "higher_is_worse",
+    "step_time_mean_ms": "higher_is_worse",
+    "mfu": "lower_is_worse",
+}
+
+#: config facts that change what a fair step-wall comparison means —
+#: recorded so a regression WARN can say "...and the config changed"
+SIGNATURE_FIELDS = ("model", "mesh", "scan_layers", "fsdp", "fsdp_overlap",
+                    "ddp_overlap", "tp_overlap", "grad_comm", "bf16",
+                    "per_device_train_batch_size",
+                    "gradient_accumulation_steps", "remat")
+
+
+def config_signature(config: Any, *, n_devices: int | None = None
+                     ) -> dict[str, Any]:
+    """The comparable-run signature of a config (plus device count —
+    the reshard case this tripwire exists for)."""
+    sig = {f: getattr(config, f, None) for f in SIGNATURE_FIELDS}
+    if n_devices is not None:
+        sig["n_devices"] = int(n_devices)
+    return sig
+
+
+def make_fingerprint(*, timer_summary: dict[str, float],
+                     mfu: float | None = None,
+                     wire_bytes_total: float | None = None,
+                     frac_host: float | None = None,
+                     steps: int | None = None,
+                     attempt: int = 1,
+                     config_sig: dict[str, Any] | None = None
+                     ) -> dict[str, Any]:
+    """One attempt's steady-state perf fingerprint (JSON-ready)."""
+    fp: dict[str, Any] = {
+        "schema_version": 1,
+        "attempt": int(attempt),
+        "time": time.time(),
+    }
+    for k in ("step_time_p50_ms", "step_time_p90_ms", "step_time_p99_ms",
+              "step_time_mean_ms"):
+        if timer_summary.get(k) is not None:
+            fp[k] = round(float(timer_summary[k]), 4)
+    if mfu is not None:
+        fp["mfu"] = float(mfu)
+    if wire_bytes_total is not None:
+        fp["wire_bytes_total"] = int(wire_bytes_total)
+    if frac_host is not None:
+        fp["frac_host"] = float(frac_host)
+    if steps is not None:
+        fp["steps"] = int(steps)
+    if config_sig is not None:
+        fp["config_sig"] = dict(config_sig)
+    return fp
+
+
+def compare_fingerprints(prior: dict[str, Any], current: dict[str, Any],
+                         *, threshold_pct: float = 20.0) -> list[str]:
+    """Direction-aware comparison; returns one human warning string per
+    out-of-band signal (empty = within band). Signals absent or
+    non-positive on either side are skipped."""
+    warnings: list[str] = []
+    config_note = ""
+    ps, cs = prior.get("config_sig"), current.get("config_sig")
+    if ps and cs and ps != cs:
+        changed = sorted(k for k in set(ps) | set(cs)
+                         if ps.get(k) != cs.get(k))
+        config_note = (" (config changed since the baseline: "
+                       + ", ".join(
+                           f"{k} {ps.get(k)!r}->{cs.get(k)!r}"
+                           for k in changed) + ")")
+    tol = float(threshold_pct) / 100.0
+    for key, direction in DIRECTIONS.items():
+        p, c = prior.get(key), current.get(key)
+        if not isinstance(p, (int, float)) or not isinstance(c, (int, float)):
+            continue
+        if p <= 0 or c <= 0:
+            continue
+        delta_pct = 100.0 * (c / p - 1.0)
+        worse = (delta_pct > 100.0 * tol
+                 if direction == "higher_is_worse"
+                 else delta_pct < -100.0 * tol)
+        if worse:
+            warnings.append(
+                f"{key} {p:.4g} -> {c:.4g} "
+                f"({delta_pct:+.1f}% vs prior attempt "
+                f"{prior.get('attempt', '?')}, band ±{threshold_pct:g}%)"
+                + config_note)
+    return warnings
+
+
+class PerfBaseline:
+    """Load/compare/persist the per-output-dir perf fingerprint."""
+
+    def __init__(self, output_dir: str | Path):
+        self.path = Path(output_dir) / FILENAME
+        self._doc = self._load()
+        #: the previous attempt's fingerprint (None on a fresh dir)
+        self.prior: dict[str, Any] | None = (
+            self._doc.get("fingerprint") if self._doc else None)
+
+    def _load(self) -> dict[str, Any] | None:
+        try:
+            if self.path.is_file():
+                return json.loads(self.path.read_text())
+        except Exception:  # noqa: BLE001 - a corrupt baseline must not
+            #               kill the run; it just stops guarding
+            log.exception("perf_baseline.json unreadable; starting fresh")
+        return None
+
+    def compare(self, current: dict[str, Any], *,
+                threshold_pct: float = 20.0) -> list[str]:
+        """Warnings for ``current`` vs the prior attempt's fingerprint
+        (empty when no prior exists or everything is in band)."""
+        if not self.prior:
+            return []
+        return compare_fingerprints(self.prior, current,
+                                    threshold_pct=threshold_pct)
+
+    def write(self, fingerprint: dict[str, Any]) -> None:
+        """Persist ``fingerprint`` as the new baseline (host 0, atomic,
+        best-effort); prior fingerprints are kept in a bounded history
+        so a slow drift across many attempts stays visible."""
+        if not is_main_process():
+            return
+        history = list((self._doc or {}).get("history", []))
+        if self._doc and self._doc.get("fingerprint"):
+            history.append(self._doc["fingerprint"])
+        payload = {
+            "schema": "perf_baseline/v1",
+            "fingerprint": fingerprint,
+            "history": history[-16:],
+            "note": "steady-state perf fingerprint per attempt; compared "
+                    "on restore — a restarted run slower than this by "
+                    "more than --regression_pct WARNs with the delta",
+        }
+        try:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            tmp = self.path.with_suffix(".json.tmp")
+            tmp.write_text(json.dumps(json_sanitize(payload), indent=2,
+                                      allow_nan=False))
+            tmp.replace(self.path)
+        except Exception:  # noqa: BLE001
+            log.exception("perf_baseline.json write failed")

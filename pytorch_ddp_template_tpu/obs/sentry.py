@@ -97,6 +97,7 @@ class AnomalySentry:
                 self._trigger = {
                     "step": int(step),
                     "reasons": reasons,
+                    "kind": "anomaly",
                     "scalars": dict(scalars),
                     "mode": self.mode,
                     "time": time.time(),
@@ -109,6 +110,39 @@ class AnomalySentry:
             # observability (the ring buffer still records every step)
             log.error("anomaly sentry triggered",
                       {"step": int(step), "reasons": reasons})
+
+    def external_trigger(self, step: int, reasons: list[str], *,
+                         kind: str = "external",
+                         scalars: dict[str, Any] | None = None) -> None:
+        """Inject a trigger from OUTSIDE the health feed — the r14 fleet
+        watchtower's straggler verdict (``kind="straggler"``) rides this
+        into the standard triage path: the loop's next poll dumps the
+        bundle with this kind and these reasons in ``trigger.json``.
+        Same first-trigger-wins contract as :meth:`observe`; safe from
+        any thread; never raises."""
+        first = False
+        with self._lock:
+            if self._trigger is None:
+                first = True
+                self._trigger = {
+                    "step": int(step),
+                    "reasons": list(reasons),
+                    "kind": kind,
+                    "scalars": dict(scalars or {}),
+                    "mode": self.mode,
+                    "time": time.time(),
+                }
+        if first:
+            log.error(f"{kind} sentry trigger",
+                      {"step": int(step), "reasons": list(reasons)})
+        else:
+            # first-trigger-wins gets the bundle, but a second verdict
+            # (two hosts confirming in one window) must not vanish —
+            # the log is its record
+            log.warning(
+                f"additional {kind} trigger suppressed (a triage "
+                "bundle is already owed to the first trigger)",
+                {"step": int(step), "reasons": list(reasons)})
 
     def _detect(self, scalars: dict[str, Any]) -> list[str]:
         reasons: list[str] = []
@@ -159,6 +193,19 @@ class AnomalySentry:
         with self._lock:
             return [{"step": s, **r} for s, r in self._ring]
 
+    def state(self) -> dict[str, Any]:
+        """JSON-ready snapshot for the ``/status`` endpoint (the
+        trigger dict itself, not just the flag — an operator hitting
+        the endpoint after a trigger wants the reasons)."""
+        with self._lock:
+            return {
+                "mode": self.mode,
+                "triggered": self._trigger is not None,
+                "trigger": (dict(self._trigger)
+                            if self._trigger is not None else None),
+                "ring_len": len(self._ring),
+            }
+
 
 class FlightRecorder:
     """Writes triage bundles under ``<output_dir>/flight_records/``."""
@@ -175,12 +222,20 @@ class FlightRecorder:
         written best-effort and independently — a failure in one artifact
         (e.g. a describe() that raises on poisoned params) must not cost
         the others."""
+        # atomic claim, not check-then-act: a fleet-replicated trigger
+        # (the r14 straggler verdict, a replicated-NaN anomaly) dumps
+        # from EVERY host at once, and on a shared output_dir a bare
+        # exists()/mkdir pair would FileExistsError the race losers and
+        # cost their bundles — mkdir itself is the test-and-set
         d = self.base / f"step_{step:08d}"
         suffix = 0
-        while d.exists():  # a re-trigger at the same step never clobbers
-            suffix += 1
-            d = self.base / f"step_{step:08d}.{suffix}"
-        d.mkdir(parents=True)
+        while True:
+            try:
+                d.mkdir(parents=True)
+                break
+            except FileExistsError:  # taken (re-trigger or peer host):
+                suffix += 1          # claim the next suffix, clobber
+                d = self.base / f"step_{step:08d}.{suffix}"  # nothing
 
         def _write(name: str, payload: Any) -> None:
             try:

@@ -1,0 +1,291 @@
+"""Live status endpoint: ``/status`` (JSON), ``/metrics`` (Prometheus
+text format), ``/healthz`` — the watchtower's window into a running job.
+
+Everything the obs stack produces today is post-hoc files in
+``output_dir``; a production fleet needs the same signals *live*, from
+every host, over the one transport every ops stack already speaks:
+HTTP. ``--status_port N`` starts a background
+``ThreadingHTTPServer`` on a daemon thread serving three routes:
+
+- ``GET /status`` — one JSON document: the latest drained telemetry
+  records by kind (progress/perf/eval), the goodput summary, sentry
+  state, the fleet table, and the startup ``describe.json`` snapshot.
+  All state is already host-side (drained) floats; request handling
+  never touches a device and never blocks the train loop.
+- ``GET /metrics`` — the same numerics in Prometheus text exposition
+  format (gauges, ``tpuddp_`` prefix), so a stock Prometheus/Grafana
+  scrape works with zero glue. Label values are escaped per the
+  exposition spec (backslash, quote, newline); metric names are
+  sanitised to ``[a-zA-Z_:][a-zA-Z0-9_:]*``.
+- ``GET /healthz`` — liveness: 200 with ``{"ok": true, step, age_s}``.
+
+Data flow: the engine chains :meth:`StatusServer.note_record` onto the
+telemetry ``on_write`` hook (drain thread) and registers lazy
+``sources`` callables (goodput summary, sentry state, fleet table) that
+are evaluated per request — the server holds no stale copies of state
+that changes between scrapes. Updates are whole-value rebinds under one
+lock; a request sees a consistent snapshot.
+
+Lifecycle: started before the train loop, closed in the engine's
+``finally`` (crash-safe: a dying run takes its endpoint down instead of
+serving frozen numbers forever). Binding failures log and disable the
+server — the endpoint must never cost the run it observes.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable
+
+from ..utils import get_logger
+from ..utils.dist import process_index
+from ..utils.serialization import json_sanitize
+
+log = get_logger(__name__)
+
+#: every Prometheus metric this exporter emits is a gauge with this
+#: prefix (one namespace, greppable, collision-free with node exporters)
+PROM_PREFIX = "tpuddp_"
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def prom_name(key: str) -> str:
+    """Sanitise a record key into a legal Prometheus metric name."""
+    name = _NAME_OK.sub("_", str(key))
+    if not name or name[0].isdigit():
+        name = "_" + name
+    return PROM_PREFIX + name
+
+
+def prom_escape(value: str) -> str:
+    """Escape a label value per the Prometheus text exposition format:
+    backslash, double-quote and newline."""
+    return (str(value).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
+def _gauge(lines: list[str], seen: set[str], name: str, value: Any,
+           labels: dict[str, Any] | None = None, help_: str = "") -> None:
+    """Append one gauge sample (TYPE/HELP emitted once per metric).
+    Non-numeric and non-finite values are skipped — a scrape must stay
+    parseable even while the job is mid-NaN (the JSON channel keeps the
+    ``null``+``_repr`` spelling for those). A repeated (name, labels)
+    sample is skipped too: duplicate samples make the whole exposition
+    invalid to Prometheus, and ``perf_*`` fields legitimately appear in
+    BOTH the progress record and an off-cadence ``perf`` record — first
+    emitter (the fresher progress record) wins."""
+    try:
+        v = float(value)
+    except (TypeError, ValueError):
+        return
+    if v != v or v in (float("inf"), float("-inf")):
+        return
+    label_s = ""
+    if labels:
+        inner = ",".join(f'{k}="{prom_escape(v2)}"'
+                         for k, v2 in labels.items())
+        label_s = "{" + inner + "}"
+    if name + label_s in seen:
+        return
+    seen.add(name + label_s)
+    if ("#type#" + name) not in seen:
+        seen.add("#type#" + name)
+        if help_:
+            lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} gauge")
+    lines.append(f"{name}{label_s} {v!r}")
+
+
+def prometheus_lines(snapshot: dict[str, Any]) -> str:
+    """Render a ``/status``-shaped snapshot as Prometheus text format.
+
+    Flat numeric fields of the latest ``progress``/``perf`` records
+    become gauges (vectors like ``per_layer_grad_norm`` are a
+    JSONL-only channel and are skipped); goodput buckets carry a
+    ``bucket`` label; fleet signals carry a ``host`` label per row.
+    """
+    lines: list[str] = []
+    seen: set[str] = set()
+    host = str(snapshot.get("host", 0))
+    _gauge(lines, seen, prom_name("step"), snapshot.get("step", 0),
+           {"host": host}, help_="latest drained global step")
+    age = snapshot.get("age_s")
+    if age is not None:
+        _gauge(lines, seen, prom_name("last_update_age_seconds"), age,
+               {"host": host})
+    for kind in ("progress", "perf"):
+        rec = snapshot.get("records", {}).get(kind) or {}
+        for k, v in rec.items():
+            if isinstance(v, (list, tuple)) or k.endswith("_repr"):
+                continue  # vectors / repr strings: JSONL-only channels
+            _gauge(lines, seen, prom_name(k), v, {"host": host})
+    gp = snapshot.get("goodput") or {}
+    if gp.get("goodput") is not None:
+        _gauge(lines, seen, prom_name("goodput_ratio"), gp["goodput"],
+               {"host": host},
+               help_="productive_step over total wall, all attempts")
+    for bucket, secs in (gp.get("buckets_s") or {}).items():
+        _gauge(lines, seen, prom_name("goodput_seconds_total"), secs,
+               {"host": host, "bucket": bucket})
+    sentry = snapshot.get("sentry") or {}
+    if sentry:
+        _gauge(lines, seen, prom_name("anomaly_triggered"),
+               1.0 if sentry.get("triggered") else 0.0, {"host": host})
+    fleet = (snapshot.get("fleet") or {}).get("table") or {}
+    for row in fleet.get("hosts") or []:
+        h = str(int(row.get("host", 0)))
+        for k, v in row.items():
+            if k == "host":
+                continue
+            _gauge(lines, seen, prom_name(f"fleet_{k}"), v, {"host": h})
+    strag = fleet.get("straggler")
+    if fleet:
+        _gauge(lines, seen, prom_name("fleet_straggler"),
+               0.0 if strag is None else 1.0,
+               {"host": "" if strag is None else str(strag.get("host"))})
+    return "\n".join(lines) + "\n"
+
+
+class StatusServer:
+    """Background HTTP endpoint for one training process.
+
+    ``port=0`` binds an ephemeral port (tests); the engine passes the
+    configured ``--status_port``. ``self.port`` holds the actual bound
+    port after :meth:`start`.
+    """
+
+    def __init__(self, port: int = 0, *, host: str = "0.0.0.0"):
+        self._bind = (host, int(port))
+        self._lock = threading.Lock()
+        self._records: dict[str, dict[str, Any]] = {}
+        self._static: dict[str, Any] = {}
+        #: lazy per-request state providers (goodput summary, sentry
+        #: state, fleet table): evaluated at scrape time, best-effort
+        self.sources: dict[str, Callable[[], Any]] = {}
+        self._step = 0
+        self._last_update: float | None = None
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+        self.port = int(port)
+
+    # -- producers (drain thread / engine) ---------------------------------
+    def note_record(self, kind: str, step: int, host: dict[str, Any]) -> None:
+        """Latest drained telemetry record by kind (chained onto the
+        telemetry ``on_write`` hook)."""
+        with self._lock:
+            self._records[kind] = dict(host)
+            self._step = max(self._step, int(step))
+            self._last_update = time.time()
+
+    def set_static(self, key: str, value: Any) -> None:
+        """Startup facts that never change mid-run (the describe.json
+        snapshot, config)."""
+        with self._lock:
+            self._static[key] = value
+
+    # -- snapshot ----------------------------------------------------------
+    def liveness(self) -> dict[str, Any]:
+        """The ``/healthz`` payload: step + age only, no source
+        evaluation — a liveness probe hitting this every few seconds
+        must stay constant-time."""
+        with self._lock:
+            return {
+                "ok": True,
+                "step": self._step,
+                "age_s": (round(time.time() - self._last_update, 3)
+                          if self._last_update else None),
+                "host": process_index(),
+            }
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            snap: dict[str, Any] = {
+                "host": process_index(),
+                "time": time.time(),
+                "step": self._step,
+                "age_s": (round(time.time() - self._last_update, 3)
+                          if self._last_update else None),
+                "records": {k: dict(v) for k, v in self._records.items()},
+                **{k: v for k, v in self._static.items()},
+            }
+        for key, fn in self.sources.items():
+            try:
+                snap[key] = fn()
+            except Exception:  # noqa: BLE001 - one broken source must
+                #               not take down the whole endpoint
+                snap[key] = {"error": "source failed"}
+        return snap
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # noqa: A003 - silence stdlib
+                pass  # request logging would interleave the train log
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 - stdlib casing
+                try:
+                    path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                    if path == "/healthz":
+                        body = json.dumps(server.liveness()).encode()
+                        self._send(200, body, "application/json")
+                    elif path == "/status":
+                        body = json.dumps(
+                            json_sanitize(server.snapshot()),
+                            indent=2, default=str,
+                            allow_nan=False).encode()
+                        self._send(200, body, "application/json")
+                    elif path == "/metrics":
+                        body = prometheus_lines(
+                            json_sanitize(server.snapshot())).encode()
+                        self._send(200, body,
+                                   "text/plain; version=0.0.4")
+                    else:
+                        self._send(404, b'{"error": "not found"}',
+                                   "application/json")
+                except Exception:  # noqa: BLE001 - a broken scrape must
+                    #               never surface into the training run
+                    try:
+                        self._send(500, b'{"error": "internal"}',
+                                   "application/json")
+                    except Exception:  # noqa: BLE001
+                        pass
+
+        self._httpd = ThreadingHTTPServer(self._bind, Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="status-server")
+        self._thread.start()
+        log.info("status server listening",
+                 {"port": self.port,
+                  "routes": ["/status", "/metrics", "/healthz"]})
+
+    def close(self) -> None:
+        """Stop serving (idempotent; called from the engine's finally —
+        a dead run must not keep answering scrapes with frozen data)."""
+        httpd, self._httpd = self._httpd, None
+        if httpd is None:
+            return
+        try:
+            httpd.shutdown()
+            httpd.server_close()
+        except Exception:  # noqa: BLE001
+            log.exception("status server shutdown failed")
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None

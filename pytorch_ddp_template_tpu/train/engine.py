@@ -403,6 +403,36 @@ class Trainer:
         # any prior attempt's buckets from <output_dir>/goodput.json so
         # a preempted-and-restarted run reports TRUE end-to-end goodput
         self.goodput = GoodputLedger(config.output_dir)
+        # fleet watchtower (--fleet, obs/fleet.py): the loop emits this
+        # host's window as a kind="fleet" telemetry record at the perf
+        # cadence; the DRAIN thread allgathers + aggregates and, on a
+        # sustained straggler, feeds the sentry a `straggler` trigger
+        self.fleet = None
+        if config.fleet:
+            from ..obs.fleet import FleetMonitor
+
+            self.fleet = FleetMonitor(
+                threshold=config.straggler_threshold,
+                windows=config.straggler_windows,
+                on_straggler=self._on_straggler)
+            self.telemetry.on_fleet = self.fleet.observe
+        # live status endpoint (--status_port, obs/server.py): built and
+        # started in train() (it serves run-scoped state), closed in the
+        # crash-safe finally; None = off
+        self.status = None
+        # perf-regression tripwire (obs/regression.py): the prior
+        # attempt's steady-state fingerprint loads here; the first perf
+        # snapshot with enough steady samples compares against it, and
+        # the end of the run writes this attempt's fingerprint
+        from ..obs.regression import PerfBaseline
+
+        self.baseline = PerfBaseline(config.output_dir)
+        self._baseline_checked = False
+        self._last_perf_rec: dict[str, float] = {}
+        # goodput totals at the last fleet window (the window ships
+        # bucket DELTAS for THIS attempt, not lifetime totals — snapshot
+        # the prior attempts' baggage now)
+        self._fleet_gp_mark: dict[str, float] = self.goodput.totals()
         # perf attribution (--perf_report): built by _startup_reports
         # from the shared AOT compile; None = no attribution records
         self.perf = None
@@ -587,6 +617,14 @@ class Trainer:
         self.goodput.add("restore", time.perf_counter() - t_restore)
         from ..parallel.sharding import describe
 
+        # mesh + active FSDP/TP execution modes (gspmd-default vs
+        # decomposed) + per-leaf split-dim histogram + TP wire bytes:
+        # the run log records WHICH layout/schedule produced its
+        # numbers (model= supplies the geometry the TP wire accounting
+        # needs). Computed once: the startup log, the unconditional
+        # describe.json snapshot and the status endpoint all share it.
+        desc = describe(self.ctx.mesh, cfg, state.params,
+                        model=self.task.model)
         log.info(
             "***** running training *****",
             {
@@ -598,15 +636,35 @@ class Trainer:
                 "accum_steps": cfg.gradient_accumulation_steps,
                 "total_optimizer_steps": self.total_steps,
                 "resumed_at_step": start_step,
-                # mesh + active FSDP/TP execution modes (gspmd-default vs
-                # decomposed) + per-leaf split-dim histogram + TP wire
-                # bytes: the run log records WHICH layout/schedule
-                # produced its numbers (model= supplies the geometry the
-                # TP wire accounting needs)
-                **describe(self.ctx.mesh, cfg, state.params,
-                           model=self.task.model),
+                **desc,
             },
         )
+        # startup snapshot (config + mesh + overlap block), written
+        # UNCONDITIONALLY to <output_dir>/describe.json — before r14 it
+        # existed only inside flight bundles, but /status and humans
+        # need it for every run, not only the sick ones
+        snapshot = self._write_describe_snapshot(desc, start_step)
+        if cfg.status_port:
+            # opt-in live endpoint; binding failure disables it — the
+            # watchtower must never cost the run it watches
+            from ..obs.server import StatusServer
+
+            try:
+                # -1 = ephemeral: the server binds port 0 and the real
+                # port is logged / exposed as self.status.port
+                self.status = StatusServer(max(cfg.status_port, 0),
+                                           host=cfg.status_host)
+                self.status.set_static("describe", snapshot)
+                self.status.sources["goodput"] = self.goodput.summary
+                if self.sentry is not None:
+                    self.status.sources["sentry"] = self.sentry.state
+                if self.fleet is not None:
+                    self.status.sources["fleet"] = self.fleet.state
+                self.status.start()
+            except Exception:  # noqa: BLE001
+                log.exception("--status_port server failed to start; "
+                              "continuing without it")
+                self.status = None
 
         if cfg.hlo_report or cfg.perf_report:
             # best-effort by design: a report/tripwire/attribution
@@ -648,6 +706,11 @@ class Trainer:
             except Exception:  # noqa: BLE001
                 pass
             self.goodput.flush()
+            # the status endpoint dies WITH the run (crash included): a
+            # dead job answering scrapes with frozen numbers is worse
+            # than a connection refused the monitoring stack understands
+            if self.status is not None:
+                self.status.close()
             # restore only AFTER the preemption checkpoint is durably
             # written: schedulers re-deliver SIGTERM during the grace
             # window, and a default handler mid-save would defeat the
@@ -734,6 +797,10 @@ class Trainer:
 
         def _on_write(kind, step, host):  # runs on the telemetry thread
             log.info(kind, {"step": step, **host})
+            if self.status is not None:
+                # latest-record feed for /status and /metrics — same
+                # thread, already host floats, a dict copy under a lock
+                self.status.note_record(kind, step, host)
 
         telemetry.on_write = _on_write
 
@@ -1067,6 +1134,11 @@ class Trainer:
         # prior attempt of this output_dir included (obs/goodput.py)
         log.info("goodput summary", self.goodput.summary())
         self.goodput.flush()
+        # this attempt's steady-state perf fingerprint, next to
+        # goodput.json: the next attempt's regression yardstick
+        # (obs/regression.py; clean exits only — the crash path must
+        # not poison the baseline with partial numbers)
+        self._write_perf_baseline()
         return state
 
     # -- observability ----------------------------------------------------
@@ -1087,15 +1159,30 @@ class Trainer:
         now = time.perf_counter()
         stats = self.loader.stats
         marks = self._perf_marks
+        wall_s = now - marks["time"]
+        steps = global_step - marks["step"]
+        input_s = stats["consumer_wait_s"] - marks["wait"]
+        device_s = self._device_wait_s - marks["device_wait"]
+        idle_s = stats["producer_idle_s"] - marks["idle"]
         rec: dict[str, float] = {}
         if self.perf is not None:
             rec = self.perf.interval(
-                wall_s=now - marks["time"],
-                steps=global_step - marks["step"],
-                input_wait_s=stats["consumer_wait_s"] - marks["wait"],
-                device_wait_s=self._device_wait_s - marks["device_wait"],
-                producer_idle_s=stats["producer_idle_s"] - marks["idle"],
+                wall_s=wall_s,
+                steps=steps,
+                input_wait_s=input_s,
+                device_wait_s=device_s,
+                producer_idle_s=idle_s,
             )
+            self._last_perf_rec = rec
+        if self.fleet is not None:
+            # this host's fleet window: pure host float math already in
+            # hand — the DRAIN thread does the cross-host exchange
+            self._emit_fleet_window(global_step, wall_s=wall_s,
+                                    steps=steps, input_s=input_s,
+                                    device_s=device_s, idle_s=idle_s)
+        # perf-regression tripwire: one comparison per attempt, once
+        # the steady-state timer has enough honest samples
+        self._maybe_check_baseline()
         self._perf_marks = {
             "time": now, "step": global_step,
             "wait": stats["consumer_wait_s"],
@@ -1112,17 +1199,145 @@ class Trainer:
         self.goodput.flush(min_interval_s=10.0)
         return rec
 
+    def _write_describe_snapshot(self, desc: dict, start_step: int) -> dict:
+        """Satellite (r14): the config + mesh + overlap-block snapshot,
+        written UNCONDITIONALLY to ``<output_dir>/describe.json`` at
+        engine start (host 0, best-effort) — previously it existed only
+        inside flight bundles. Returns the dict (the status endpoint
+        serves it)."""
+        snapshot = {
+            "schema": "describe/v1",
+            "time": time.time(),
+            "attempt": self.goodput.attempt,
+            "resumed_at_step": start_step,
+            "total_steps": self.total_steps,
+            "mesh": {k: int(v) for k, v in self.ctx.mesh.shape.items()},
+            "n_devices": int(self.ctx.mesh.devices.size),
+            "process_count": jax.process_count(),
+            "describe": desc,
+            "config": json.loads(self.config.to_json()),
+        }
+        if is_main_process():
+            try:
+                from ..utils.serialization import json_sanitize
+
+                path = Path(self.config.output_dir) / "describe.json"
+                path.parent.mkdir(parents=True, exist_ok=True)
+                path.write_text(json.dumps(json_sanitize(snapshot),
+                                           indent=2, default=str,
+                                           allow_nan=False))
+            except Exception:  # noqa: BLE001 - the snapshot must never
+                #               cost the run it documents
+                log.exception("describe.json snapshot write failed")
+        return snapshot
+
+    def _emit_fleet_window(self, global_step: int, *, wall_s: float,
+                           steps: int, input_s: float, device_s: float,
+                           idle_s: float) -> None:
+        """Queue this host's fleet window (``kind="fleet"``): interval
+        deltas the loop already measured, as flat floats — the drain
+        thread's FleetMonitor does the allgather + aggregation."""
+        wall = max(wall_s, 1e-9)
+        n = max(steps, 1)
+        gp = self.goodput.totals()
+        mark = self._fleet_gp_mark
+        self._fleet_gp_mark = gp
+        frac_input = min(max(input_s, 0.0) / wall, 1.0)
+        frac_device = min(max(device_s, 0.0) / wall, 1.0 - frac_input)
+        window = {
+            "step": float(global_step),
+            "step_wall_ms": 1e3 * wall / n,
+            "frac_input": frac_input,
+            "frac_device": frac_device,
+            "frac_host": max(0.0, 1.0 - frac_input - frac_device),
+            "input_wait_ms": 1e3 * max(input_s, 0.0) / n,
+            "producer_idle_ms": 1e3 * max(idle_s, 0.0) / n,
+            "gp_productive_s": gp["productive_step"]
+            - mark.get("productive_step", 0.0),
+            "gp_wall_s": sum(gp.values()) - sum(mark.values()),
+            "anomaly": 1.0 if (self.sentry is not None
+                               and self.sentry.triggered) else 0.0,
+        }
+        self.telemetry.emit(global_step, window, kind="fleet")
+
+    def _on_straggler(self, step: int, verdict: dict) -> None:
+        """Fleet straggler verdict (drain thread): feed the sentry as a
+        ``straggler`` trigger so the standard triage bundle lands with
+        the offending host named — or, with no sentry configured, at
+        least say it loudly."""
+        reasons = [
+            f"host {verdict['host']} step wall "
+            f"{verdict['step_wall_ms']}ms > fleet median "
+            f"{verdict['fleet_median_ms']}ms by {verdict['excess_pct']}% "
+            f"(threshold {verdict['threshold_pct']}%) for "
+            f"{verdict['consecutive_windows']} consecutive windows"]
+        if self.sentry is not None:
+            self.sentry.external_trigger(step, reasons, kind="straggler",
+                                         scalars=verdict)
+        else:
+            log.warning(
+                "fleet straggler detected (no --anomaly sentry active, "
+                "so no triage bundle): " + reasons[0], verdict)
+
+    def _current_fingerprint(self) -> dict | None:
+        """This attempt's steady-state perf fingerprint from the honest
+        StepTimer + whatever --perf_report produced (None before any
+        step samples exist)."""
+        from ..obs.regression import config_signature, make_fingerprint
+
+        summ = self.step_timer.summary()
+        if not summ:
+            return None
+        cm = self.perf.cost_model if self.perf is not None else {}
+        return make_fingerprint(
+            timer_summary=summ,
+            mfu=self._last_perf_rec.get("perf_mfu"),
+            wire_bytes_total=cm.get("wire_bytes_total"),
+            frac_host=self._last_perf_rec.get("perf_frac_host"),
+            steps=self.step_timer.sample_count,
+            attempt=self.goodput.attempt,
+            config_sig=config_signature(
+                self.config, n_devices=int(self.ctx.mesh.devices.size)),
+        )
+
+    def _maybe_check_baseline(self) -> None:
+        """The restore-compare tripwire: ONCE per attempt, after the
+        timer holds enough steady samples, compare against the prior
+        attempt's ``perf_baseline.json`` and WARN per out-of-band
+        signal. Best-effort by design."""
+        if self._baseline_checked or self.baseline.prior is None:
+            return
+        if self.step_timer.sample_count < 16:
+            return  # not steady state yet; a later snapshot will check
+        self._baseline_checked = True
+        try:
+            current = self._current_fingerprint()
+            if current is None:
+                return
+            for w in self.baseline.compare(
+                    current, threshold_pct=self.config.regression_pct):
+                log.warning("perf regression vs prior attempt: " + w)
+        except Exception:  # noqa: BLE001 - tripwire must not cost the run
+            log.exception("perf baseline comparison failed")
+
+    def _write_perf_baseline(self) -> None:
+        """Persist this attempt's fingerprint next to goodput.json
+        (clean shutdown path only: a crashed attempt's partial numbers
+        must not become the next attempt's yardstick)."""
+        try:
+            current = self._current_fingerprint()
+            if current is not None:
+                self.baseline.write(current)
+        except Exception:  # noqa: BLE001
+            log.exception("perf_baseline.json write failed")
+
     def _on_anomaly_trigger(self, state, trig, global_step, main_trace):
         """Handle a sentry trigger on the loop thread: dump the triage
         bundle, arm a short profiler capture over the NEXT few steps into
         the bundle directory, and (halt mode) schedule the coherent stop."""
         from ..obs.sentry import FLIGHT_TRACE_STEPS
+        from ..utils.dist import process_index
 
-        flight_dir = None
-        try:
-            flight_dir = self._dump_flight_record(state, trig)
-        except Exception:  # noqa: BLE001 - triage must not kill training
-            log.exception("flight-record dump failed")
         # one live jax-profiler trace per process: skip the capture when
         # the --profile_steps window is mid-capture OR would OPEN inside
         # the flight window [global_step, global_step+N) — starting a
@@ -1132,14 +1347,46 @@ class Trainer:
             main_trace.enabled
             and main_trace.stop_at > global_step
             and main_trace.start < global_step + FLIGHT_TRACE_STEPS)
-        if (flight_dir is not None and self._flight_trace is None
-                and not main_overlaps):
+        # trigger.json names WHICH host dumped (every host runs its own
+        # sentry) and which host will trace — decided before the dump so
+        # the bundle's record is complete, not reconstructed. A
+        # straggler verdict is fleet-replicated (every host saw the same
+        # allgathered table), so only the NAMED host traces — N
+        # simultaneous captures of N healthy hosts would be noise;
+        # health-anomaly triggers trace wherever they fired (the r14
+        # satellite fix for the r12 host-0 pin)
+        named = ((trig.get("scalars") or {}).get("host")
+                 if trig.get("kind") == "straggler" else None)
+        my_turn = named is None or int(named) == process_index()
+        will_trace = (self._flight_trace is None and not main_overlaps
+                      and my_turn)
+        trig = dict(trig)
+        trig["host"] = process_index()
+        if will_trace:
+            trig["trace_host"] = process_index()
+        elif named is not None and int(named) != process_index():
+            # another host is expected to capture (it decides locally)
+            trig["trace_host"] = int(named)
+        else:
+            # nobody will: this host was the one to trace but a live
+            # window blocks it — the metadata must not point at a
+            # trace that does not exist
+            trig["trace_host"] = None
+        flight_dir = None
+        try:
+            flight_dir = self._dump_flight_record(state, trig)
+        except Exception:  # noqa: BLE001 - triage must not kill training
+            log.exception("flight-record dump failed")
+        if flight_dir is not None and will_trace:
             # start_step = the CURRENT counter: the next iteration's
             # loop-top step() call still carries this value (the counter
-            # increments after dispatch), so capture starts immediately
+            # increments after dispatch), so capture starts immediately.
+            # all_hosts: the triggering host captures its LOCAL trace —
+            # the r12 host-0 pin silently lost every trace whose anomaly
+            # fired on a non-zero host (r14 satellite fix)
             self._flight_trace = TraceWindow(
                 flight_dir, start_step=global_step,
-                num_steps=FLIGHT_TRACE_STEPS)
+                num_steps=FLIGHT_TRACE_STEPS, all_hosts=True)
         elif flight_dir is not None and main_overlaps:
             log.info(
                 "flight-record trace skipped: --profile_steps window "

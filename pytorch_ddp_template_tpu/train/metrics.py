@@ -40,6 +40,14 @@ from ..utils.serialization import json_sanitize
 
 log = get_logger(__name__)
 
+#: ``metrics.jsonl`` record schema version, stamped on every record so
+#: ``tools/bench_diff.py`` and external scrapers can evolve safely.
+#: History: v1 = the pre-r14 implicit schema (step/time + flat floats,
+#: non-finite as ``null``+``"<key>_repr"``, vectors JSONL-only);
+#: v2 = v1 plus this very field. Bump when a record's MEANING changes,
+#: not when fields are added (additive keys are always legal).
+SCHEMA_VERSION = 2
+
 
 class MetricsWriter:
     """Host-0 scalar writer: TensorBoard events (if available) + JSONL.
@@ -71,7 +79,8 @@ class MetricsWriter:
     def write(self, step: int, scalars: dict[str, Any]) -> None:
         if not self.active:
             return
-        record = {"step": step, "time": time.time()}
+        record = {"step": step, "time": time.time(),
+                  "schema_version": SCHEMA_VERSION}
         record.update({
             k: [float(x) for x in v] if isinstance(v, (list, tuple))
             else float(v)
@@ -144,6 +153,14 @@ OnWrite = Callable[[str, int, dict[str, float]], None]
 #: logging-boundary progress record carries the same fields durably.
 OnHealth = Callable[[int, dict[str, Any]], None]
 
+#: fleet-record consumer: (step, host_scalars) — the r14 fleet
+#: watchtower's ``observe``. ``kind="fleet"`` records route HERE, never
+#: to the writer: the cross-host allgather belongs on the drain thread
+#: (it may block on a lagging peer), and the aggregated table is served
+#: by the status endpoint rather than duplicated into metrics.jsonl
+#: (the progress record already carries this host's raw signals).
+OnFleet = Callable[[int, dict[str, Any]], None]
+
 
 class SyncTelemetry:
     """Inline sink: convert-and-write at emit time, blocking on the
@@ -157,6 +174,7 @@ class SyncTelemetry:
         self.latest: dict[str, float] = {}
         self.on_write: OnWrite | None = None
         self.on_health: OnHealth | None = None
+        self.on_fleet: OnFleet | None = None
 
     def emit(self, step: int, scalars: dict[str, Any],
              kind: str = "progress") -> None:
@@ -166,6 +184,12 @@ class SyncTelemetry:
             # (the async sink is the production path — BENCH_MODE=obs)
             if self.on_health is not None:
                 self.on_health(step, _to_host(scalars))
+            return
+        if kind == "fleet":
+            # inline exchange, same sync-mode contract: the allgather
+            # blocks the loop here (async is the production path)
+            if self.on_fleet is not None:
+                self.on_fleet(step, _to_host(scalars))
             return
         host = _to_host(scalars)
         self.latest = host
@@ -197,6 +221,7 @@ class AsyncTelemetry:
         self.latest: dict[str, float] = {}
         self.on_write: OnWrite | None = None
         self.on_health: OnHealth | None = None
+        self.on_fleet: OnFleet | None = None
         # bounded: if the writer ever falls an entire queue behind, emit
         # blocks rather than growing host buffers without limit
         self._q: queue.Queue = queue.Queue(maxsize=maxsize)
@@ -230,6 +255,17 @@ class AsyncTelemetry:
                 self.on_health(step, _to_host(scalars))
             except Exception:  # noqa: BLE001 - sentry must not kill drain
                 log.exception("health record dropped")
+            return
+        if kind == "fleet":
+            # the r14 cross-host exchange: converted + allgathered on
+            # this (drain) thread so a lagging peer can never stall the
+            # hot loop; routed to the FleetMonitor, never to the writer
+            if self.on_fleet is None:
+                return
+            try:
+                self.on_fleet(step, _to_host(scalars))
+            except Exception:  # noqa: BLE001 - fleet must not kill drain
+                log.exception("fleet record dropped")
             return
         if not self.writer.active and self.on_write is None:
             return  # non-main process: nothing consumes the conversion

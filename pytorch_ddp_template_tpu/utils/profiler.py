@@ -63,18 +63,27 @@ def annotate(name: str):
 
 
 class TraceWindow:
-    """Capture a profiler trace over a step window (host 0 only).
+    """Capture a profiler trace over a step window.
+
+    Host 0 only by default (the ``--profile_steps`` convention: one
+    trace per run, written where the operator looks). ``all_hosts=True``
+    lifts the pin — the r12 flight recorder's post-trigger capture uses
+    it, because the host whose sentry fired is the host whose trace
+    matters, and before r14 a trigger on a non-zero host silently
+    produced no trace at all.
 
     Usage: call :meth:`step` once per training step; the window
     [start_step, start_step + num_steps) is traced.
     """
 
     def __init__(self, output_dir: str | Path, start_step: int = 10,
-                 num_steps: int = 0, enabled: bool = True):
+                 num_steps: int = 0, enabled: bool = True,
+                 all_hosts: bool = False):
         self.dir = str(Path(output_dir) / "profile")
         self.start = start_step
         self.stop_at = start_step + num_steps
-        self.enabled = enabled and num_steps > 0 and jax.process_index() == 0
+        host_ok = all_hosts or jax.process_index() == 0
+        self.enabled = enabled and num_steps > 0 and host_ok
         self._active = False
 
     def step(self, step: int) -> None:
@@ -130,6 +139,12 @@ class StepTimer:
                 self._times.append(dt)  # maxlen evicts the oldest
         self._last = now
         return dt
+
+    @property
+    def sample_count(self) -> int:
+        """Recorded (non-discarded) intervals currently held — the
+        steady-state-readiness gate for the r14 baseline comparison."""
+        return len(self._times)
 
     def p50_ms(self) -> float | None:
         """Median recorded step time in ms (None before any sample) —

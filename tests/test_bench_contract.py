@@ -473,6 +473,76 @@ def test_perf_record_committed_and_affirmative():
     assert last["goodput_buckets_s"]["compile"] > 0
 
 
+@pytest.mark.slow
+def test_fleet_mode_contract():
+    """BENCH_MODE=fleet: one JSON line carrying the round-14 fleet
+    watchtower legs — the fleet+status+sentry neutrality pair over the
+    full production loop, the live endpoint scrape, the
+    injected-straggler bundle and the bench_diff tripwire pair (slow:
+    seven full Trainer runs in a subprocess; the committed record in
+    bench_records/fleet_cpu_r14.jsonl is the tier-1-visible evidence)."""
+    code, lines, out = run_bench({
+        "BENCH_MODE": "fleet", "BENCH_MODEL": "mlp",
+        "BENCH_BATCH": "8", "BENCH_WARMUP": "1", "BENCH_STEPS": "6",
+        "BENCH_LOG_STEPS": "2", "BENCH_OUTPUT": "/tmp/bench_fleet_contract",
+    })
+    assert code == 0, out[-2000:]
+    assert len(lines) == 1, out[-2000:]
+    row = lines[0]
+    assert REQUIRED <= set(row)
+    assert row["metric"] == "fleet_overhead_ratio"
+    assert row["value"] > 0
+    assert row["fleet_exchanges"] > 0
+    # live endpoints answered during the run
+    assert row["status_http_ok"] is True
+    assert row["metrics_http_ok"] is True
+    assert row["healthz_ok"] is True
+    assert row["status_has_fleet_table"] is True
+    # the injected straggler produced a named bundle; the trace belongs
+    # to the named host (the fake host 2), recorded in trigger.json
+    assert row["straggler_bundle_complete"] is True
+    assert row["straggler_trigger_kind"] == "straggler"
+    assert row["straggler_named_host"] == 2
+    assert row["straggler_trace_host"] == 2
+    # the committed records pass the tripwire; a slowed copy trips it
+    assert row["bench_diff_committed_rc"] == 0
+    assert row["bench_diff_slowed_rc"] != 0
+
+
+def test_fleet_record_committed_and_affirmative():
+    """The committed round-14 CPU record must exist and actually show
+    the evidence the round claims: fleet+status+sentry inside the 0.9
+    step-time band, all three endpoints live mid-run, the injected
+    straggler riding the sentry into a complete bundle naming host 2,
+    and tools/bench_diff.py passing the committed records while
+    tripping on a synthetically slowed copy."""
+    import json
+    from pathlib import Path
+
+    from pytorch_ddp_template_tpu.obs.sentry import BUNDLE_FILES
+
+    path = Path(__file__).resolve().parent.parent / "bench_records" / \
+        "fleet_cpu_r14.jsonl"
+    assert path.is_file(), "run BENCH_MODE=fleet to record the legs"
+    records = [json.loads(l) for l in path.read_text().splitlines() if l]
+    assert records
+    last = records[-1]
+    assert last["metric"] == "fleet_overhead_ratio"
+    assert last["value"] >= 0.9  # neutrality band: the watchtower is ~free
+    assert last["vs_baseline"] >= 1.0
+    assert last["fleet_exchanges"] > 0
+    assert last["status_http_ok"] is True
+    assert last["metrics_http_ok"] is True
+    assert last["healthz_ok"] is True
+    assert last["straggler_bundle_complete"] is True
+    assert set(BUNDLE_FILES) <= set(last["straggler_bundle_files"])
+    assert last["straggler_trigger_kind"] == "straggler"
+    assert last["straggler_named_host"] == 2
+    assert last["straggler_trace_host"] == 2  # the NAMED host traces
+    assert last["bench_diff_committed_rc"] == 0
+    assert last["bench_diff_slowed_rc"] != 0
+
+
 def test_comms_record_committed_and_affirmative():
     """The committed round-9 CPU record must exist and actually show the
     evidence the round claims: >= depth independent in-scan reduces, int8

@@ -1,0 +1,724 @@
+"""Round-14 fleet watchtower: obs/fleet.py (cross-host aggregation +
+straggler verdict), obs/server.py (/status + /metrics + /healthz,
+Prometheus text format), obs/regression.py (perf_baseline.json
+restore-compare tripwire), tools/bench_diff.py, and the engine wiring —
+the straggler-trigger → sentry-bundle path, the live endpoint during a
+real ``Trainer.train()``, the unconditional describe.json snapshot, and
+the metrics.jsonl ``schema_version`` stamp."""
+
+import json
+import sys
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from pytorch_ddp_template_tpu.obs.fleet import (
+    FLEET_WIRE_KEYS,
+    FleetMonitor,
+    decode_rows,
+    encode_window,
+)
+from pytorch_ddp_template_tpu.obs.regression import (
+    PerfBaseline,
+    compare_fingerprints,
+    config_signature,
+    make_fingerprint,
+)
+from pytorch_ddp_template_tpu.obs.sentry import AnomalySentry
+from pytorch_ddp_template_tpu.obs.server import (
+    StatusServer,
+    prom_escape,
+    prom_name,
+    prometheus_lines,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+import bench_diff  # noqa: E402
+
+
+def window(step=10, wall=5.0, **over):
+    w = {k: 0.0 for k in FLEET_WIRE_KEYS}
+    w.update(step=float(step), step_wall_ms=wall, frac_host=0.1,
+             frac_input=0.05, frac_device=0.85, input_wait_ms=0.2,
+             producer_idle_ms=3.0, gp_productive_s=1.0, gp_wall_s=1.1)
+    w.update(over)
+    return w
+
+
+def fake_fleet(walls):
+    """A faked multi-host exchange: every call returns one row per
+    entry of ``walls``, this host's vector with step_wall_ms rewritten."""
+    wall_i = FLEET_WIRE_KEYS.index("step_wall_ms")
+
+    def exchange(vec):
+        rows = np.stack([vec] * len(walls))
+        for i, w in enumerate(walls):
+            rows[i, wall_i] = w
+        return rows
+
+    return exchange
+
+
+# -- wire codec ------------------------------------------------------------
+
+class TestWireCodec:
+    def test_roundtrip(self):
+        w = window(step=7, wall=12.5, anomaly=1.0)
+        rows = decode_rows(encode_window(w)[None, :])
+        assert len(rows) == 1
+        assert rows[0]["host"] == 0
+        for k in FLEET_WIRE_KEYS:
+            assert rows[0][k] == pytest.approx(w[k], rel=1e-6), k
+
+    def test_missing_keys_ship_as_zero(self):
+        vec = encode_window({"step_wall_ms": 3.0})
+        rec = decode_rows(vec[None, :])[0]
+        assert rec["step_wall_ms"] == pytest.approx(3.0)
+        assert rec["frac_input"] == 0.0
+
+    def test_short_rows_zero_fill(self):
+        # an older peer shipping fewer columns must not misalign
+        rows = decode_rows(np.ones((2, 3), np.float32))
+        assert rows[1]["step"] == 1.0
+        assert rows[1][FLEET_WIRE_KEYS[-1]] == 0.0
+
+
+# -- aggregation -----------------------------------------------------------
+
+class TestAggregation:
+    def test_min_median_max_per_signal(self):
+        mon = FleetMonitor()
+        hosts = decode_rows(np.stack([
+            encode_window(window(wall=w)) for w in (4.0, 10.0, 6.0)]))
+        table = mon.aggregate(hosts, step=20)
+        sig = table["signals"]["step_wall_ms"]
+        assert sig["min"] == pytest.approx(4.0)
+        assert sig["median"] == pytest.approx(6.0)
+        assert sig["max"] == pytest.approx(10.0)
+        assert table["n_hosts"] == 3
+        assert [h["host"] for h in table["hosts"]] == [0, 1, 2]
+
+    def test_anomaly_hosts_named(self):
+        mon = FleetMonitor()
+        hosts = [dict(window(), host=0.0),
+                 dict(window(anomaly=1.0), host=1.0)]
+        table = mon.aggregate(hosts)
+        assert table["anomaly_hosts"] == [1]
+
+
+# -- straggler detection ---------------------------------------------------
+
+class TestStragglerVerdict:
+    def observe_n(self, mon, walls, n, start=0):
+        mon._exchange = fake_fleet(walls)
+        for i in range(n):
+            mon.observe(start + i, window())
+
+    def test_needs_k_consecutive_windows(self):
+        fired = []
+        mon = FleetMonitor(threshold=0.25, windows=3,
+                           on_straggler=lambda s, v: fired.append((s, v)))
+        self.observe_n(mon, [5.0, 5.0, 9.0], 2)
+        assert fired == []  # two suspect windows < K=3
+        self.observe_n(mon, [5.0, 5.0, 9.0], 1, start=2)
+        assert len(fired) == 1
+        step, verdict = fired[0]
+        assert verdict["host"] == 2
+        assert verdict["consecutive_windows"] == 3
+        assert verdict["excess_pct"] == pytest.approx(80.0)
+        assert mon.latest_table["straggler"] == verdict
+
+    def test_recovery_resets_and_rearms(self):
+        fired = []
+        mon = FleetMonitor(threshold=0.25, windows=2,
+                           on_straggler=lambda s, v: fired.append(v))
+        self.observe_n(mon, [5.0, 5.0, 9.0], 2)
+        assert len(fired) == 1
+        # still slow: flagged hosts do NOT re-fire every window
+        self.observe_n(mon, [5.0, 5.0, 9.0], 3, start=2)
+        assert len(fired) == 1
+        # recovers, then degrades again: a NEW episode, a new verdict
+        self.observe_n(mon, [5.0, 5.0, 5.0], 1, start=5)
+        self.observe_n(mon, [5.0, 5.0, 9.0], 2, start=6)
+        assert len(fired) == 2
+
+    def test_headline_persists_for_the_whole_episode(self):
+        """The table's straggler slot must stay set on every window of
+        an ongoing degradation (scrapers alert on it), not only the
+        confirmation window — and clear on recovery."""
+        fired = []
+        mon = FleetMonitor(threshold=0.25, windows=2,
+                           on_straggler=lambda s, v: fired.append(v))
+        self.observe_n(mon, [5.0, 5.0, 9.0], 5)
+        assert len(fired) == 1  # one verdict per episode...
+        strag = mon.latest_table["straggler"]
+        assert strag is not None  # ...but the headline stays up
+        assert strag["host"] == 2
+        assert strag["consecutive_windows"] == 5
+        self.observe_n(mon, [5.0, 5.0, 5.0], 1, start=5)
+        assert mon.latest_table["straggler"] is None  # recovered
+
+    def test_two_stragglers_both_named(self):
+        """A degraded switch can sicken two hosts at once: BOTH get a
+        verdict (naming only the slowest would suppress the other for
+        its whole episode); the table headline carries the slowest."""
+        fired = []
+        mon = FleetMonitor(threshold=0.25, windows=2,
+                           on_straggler=lambda s, v: fired.append(v))
+        self.observe_n(mon, [5.0, 5.0, 9.0, 12.0], 2)
+        assert sorted(v["host"] for v in fired) == [2, 3]
+        assert mon.latest_table["straggler"]["host"] == 3  # slowest
+
+    def test_interrupted_streak_never_fires(self):
+        fired = []
+        mon = FleetMonitor(threshold=0.25, windows=3,
+                           on_straggler=lambda s, v: fired.append(v))
+        for _ in range(4):  # slow-slow-fast forever: never 3 in a row
+            self.observe_n(mon, [5.0, 5.0, 9.0], 2)
+            self.observe_n(mon, [5.0, 5.0, 5.0], 1)
+        assert fired == []
+
+    def test_small_fleet_never_fires(self):
+        # with 2 hosts the median straddles both; a slow pair would
+        # blame an innocent — the verdict needs >= 3 hosts
+        fired = []
+        mon = FleetMonitor(threshold=0.1, windows=1,
+                           on_straggler=lambda s, v: fired.append(v))
+        self.observe_n(mon, [5.0, 50.0], 4)
+        assert fired == []
+        assert mon.latest_table["n_hosts"] == 2
+
+    def test_exchange_failure_degrades_to_local(self):
+        mon = FleetMonitor()
+
+        def broken(vec):
+            raise RuntimeError("DCN down")
+
+        mon._exchange = broken
+        mon.observe(1, window())
+        assert mon.latest_table["n_hosts"] == 1
+        assert mon.state()["degraded_to_local"] is True
+
+    def test_observe_never_raises(self):
+        mon = FleetMonitor()
+        mon.on_straggler = lambda s, v: 1 / 0  # a broken consumer
+        mon._exchange = fake_fleet([1.0, 1.0, 99.0])
+        mon.observe(0, window())  # must not raise (drain-thread contract)
+
+    def test_constructor_validation(self):
+        with pytest.raises(ValueError):
+            FleetMonitor(threshold=0.0)
+        with pytest.raises(ValueError):
+            FleetMonitor(windows=0)
+
+
+# -- sentry external trigger -----------------------------------------------
+
+class TestExternalTrigger:
+    def test_straggler_kind_delivered_once(self):
+        s = AnomalySentry("warn")
+        s.external_trigger(12, ["host 2 slow"], kind="straggler",
+                           scalars={"host": 2})
+        trig = s.poll_trigger()
+        assert trig["kind"] == "straggler"
+        assert trig["step"] == 12
+        assert trig["scalars"]["host"] == 2
+        assert s.poll_trigger() is None  # exactly-once
+        # first-trigger-wins: a later health trigger does not clobber
+        s.external_trigger(13, ["again"], kind="straggler")
+        assert s.poll_trigger() is None
+
+    def test_health_trigger_carries_anomaly_kind(self):
+        s = AnomalySentry("warn")
+        s.observe(5, {"loss": float("nan")})
+        assert s.poll_trigger()["kind"] == "anomaly"
+
+    def test_state_snapshot(self):
+        s = AnomalySentry("halt", window=16)
+        s.observe(1, {"loss": 1.0})
+        st = s.state()
+        assert st == {"mode": "halt", "triggered": False,
+                      "trigger": None, "ring_len": 1}
+        s.external_trigger(2, ["x"], kind="straggler")
+        assert s.state()["triggered"] is True
+        assert s.state()["trigger"]["kind"] == "straggler"
+
+
+# -- prometheus rendering --------------------------------------------------
+
+class TestPrometheus:
+    def test_escaping(self):
+        assert prom_escape('a"b') == 'a\\"b'
+        assert prom_escape("a\\b") == "a\\\\b"
+        assert prom_escape("a\nb") == "a\\nb"
+
+    def test_name_sanitised(self):
+        assert prom_name("step_time_p50_ms") == "tpuddp_step_time_p50_ms"
+        assert prom_name("weird-key.50%") == "tpuddp_weird_key_50_"
+        assert prom_name("9lives")[len("tpuddp_"):][0] == "_"
+
+    def snapshot(self):
+        return {
+            "host": 0, "step": 40, "age_s": 1.5,
+            "records": {"progress": {
+                "loss": 1.25, "steps_per_sec": 10.0,
+                "per_layer_grad_norm": [1.0, 2.0],  # vector: skipped
+                "loss_repr": "nan",                  # repr: skipped
+                "bad": None}},
+            "goodput": {"goodput": 0.9,
+                        "buckets_s": {"compile": 3.0, "halted": 0.5}},
+            "sentry": {"triggered": True},
+            "fleet": {"table": {
+                "hosts": [{"host": 0, "step_wall_ms": 5.0},
+                          {"host": 1, "step_wall_ms": 9.0}],
+                "straggler": {"host": 1}}},
+        }
+
+    def test_rendering(self):
+        text = prometheus_lines(self.snapshot())
+        assert "tpuddp_step{host=\"0\"} 40" in text
+        assert "tpuddp_loss{host=\"0\"} 1.25" in text
+        assert "# TYPE tpuddp_loss gauge" in text
+        assert 'tpuddp_goodput_seconds_total{host="0",bucket="compile"} 3.0' \
+            in text
+        assert "tpuddp_anomaly_triggered" in text
+        assert 'tpuddp_fleet_step_wall_ms{host="1"} 9.0' in text
+        assert 'tpuddp_fleet_straggler{host="1"} 1.0' in text
+        assert "per_layer_grad_norm" not in text  # vectors skipped
+        assert "_repr" not in text
+        # every sample line parses as `name{labels} float`
+        for line in text.splitlines():
+            if line.startswith("#") or not line:
+                continue
+            name, _, value = line.rpartition(" ")
+            float(value)
+            assert name.startswith("tpuddp_")
+
+    def test_duplicate_samples_deduped(self):
+        """perf_* fields can appear in BOTH the progress record and an
+        off-cadence perf record; a duplicate (name, labels) sample makes
+        the whole exposition invalid to Prometheus — first wins."""
+        snap = self.snapshot()
+        snap["records"]["progress"]["perf_mfu"] = 0.4
+        snap["records"]["perf"] = {"perf_mfu": 0.39, "perf_step_ms": 2.0}
+        text = prometheus_lines(snap)
+        mfu_lines = [l for l in text.splitlines()
+                     if l.startswith("tpuddp_perf_mfu{")]
+        assert mfu_lines == ['tpuddp_perf_mfu{host="0"} 0.4']
+        assert 'tpuddp_perf_step_ms{host="0"} 2.0' in text
+
+    def test_non_finite_values_skipped(self):
+        snap = self.snapshot()
+        snap["records"]["progress"]["loss"] = float("nan")
+        text = prometheus_lines(snap)
+        assert "tpuddp_loss" not in text
+        assert "nan" not in text.lower().replace("tpuddp", "")
+
+
+# -- status server (no engine, no jax) -------------------------------------
+
+def _get(port, route):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{route}",
+                                timeout=5) as r:
+        return r.status, r.read().decode()
+
+
+class TestStatusServer:
+    def test_serves_all_routes(self):
+        srv = StatusServer(0, host="127.0.0.1")  # ephemeral port
+        srv.set_static("describe", {"mesh": {"data": 8}})
+        srv.sources["goodput"] = lambda: {"goodput": 0.5,
+                                          "buckets_s": {"compile": 1.0}}
+        srv.start()
+        try:
+            srv.note_record("progress", 12, {"loss": 0.5})
+            code, body = _get(srv.port, "/status")
+            assert code == 200
+            snap = json.loads(body)
+            assert snap["step"] == 12
+            assert snap["records"]["progress"]["loss"] == 0.5
+            assert snap["describe"]["mesh"] == {"data": 8}
+            assert snap["goodput"]["goodput"] == 0.5
+            code, body = _get(srv.port, "/healthz")
+            assert code == 200 and json.loads(body)["ok"] is True
+            code, body = _get(srv.port, "/metrics")
+            assert code == 200
+            assert "tpuddp_loss" in body and "tpuddp_goodput_ratio" in body
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(srv.port, "/nope")
+            assert e.value.code == 404
+        finally:
+            srv.close()
+        srv.close()  # idempotent
+
+    def test_broken_source_does_not_kill_endpoint(self):
+        srv = StatusServer(0, host="127.0.0.1")
+        srv.sources["bad"] = lambda: 1 / 0
+        srv.start()
+        try:
+            code, body = _get(srv.port, "/status")
+            assert code == 200
+            assert json.loads(body)["bad"] == {"error": "source failed"}
+        finally:
+            srv.close()
+
+
+# -- perf baseline / regression tripwire -----------------------------------
+
+class TestRegression:
+    def fp(self, p50=10.0, mfu=0.4, attempt=1, sig=None):
+        return make_fingerprint(
+            timer_summary={"step_time_p50_ms": p50,
+                           "step_time_p90_ms": p50 * 1.2,
+                           "step_time_mean_ms": p50 * 1.05},
+            mfu=mfu, wire_bytes_total=1000, frac_host=0.1,
+            steps=100, attempt=attempt, config_sig=sig)
+
+    def test_in_band_is_silent(self):
+        assert compare_fingerprints(self.fp(), self.fp(p50=11.0),
+                                    threshold_pct=20.0) == []
+
+    def test_slower_step_wall_warns_with_delta(self):
+        warns = compare_fingerprints(self.fp(p50=10.0),
+                                     self.fp(p50=14.0),
+                                     threshold_pct=20.0)
+        assert any("step_time_p50_ms" in w and "+40.0%" in w
+                   for w in warns)
+
+    def test_faster_is_never_a_regression(self):
+        assert compare_fingerprints(self.fp(p50=10.0), self.fp(p50=5.0),
+                                    threshold_pct=20.0) == []
+
+    def test_lower_mfu_warns_higher_does_not(self):
+        assert any("mfu" in w for w in compare_fingerprints(
+            self.fp(mfu=0.4), self.fp(mfu=0.2), threshold_pct=20.0))
+        assert compare_fingerprints(
+            self.fp(mfu=0.2), self.fp(mfu=0.4), threshold_pct=20.0) == []
+
+    def test_missing_signals_skipped(self):
+        prior = self.fp()
+        current = {k: v for k, v in self.fp(p50=99.0).items()
+                   if not k.startswith("step_time")}
+        warns = compare_fingerprints(prior, current, threshold_pct=20.0)
+        assert not any("step_time" in w for w in warns)
+
+    def test_config_change_named_in_warning(self):
+        a = self.fp(p50=10.0, sig={"mesh": "data:8", "model": "mlp"})
+        b = self.fp(p50=20.0, sig={"mesh": "data:4", "model": "mlp"})
+        warns = compare_fingerprints(a, b, threshold_pct=20.0)
+        assert any("config changed" in w and "data:8" in w for w in warns)
+
+    def test_baseline_write_load_history(self, tmp_path):
+        b1 = PerfBaseline(tmp_path)
+        assert b1.prior is None
+        b1.write(self.fp(p50=10.0, attempt=1))
+        b2 = PerfBaseline(tmp_path)
+        assert b2.prior["step_time_p50_ms"] == pytest.approx(10.0)
+        assert b2.compare(self.fp(p50=20.0))  # out of band -> warns
+        assert b2.compare(self.fp(p50=10.5)) == []
+        b2.write(self.fp(p50=11.0, attempt=2))
+        doc = json.loads((tmp_path / "perf_baseline.json").read_text())
+        assert doc["fingerprint"]["attempt"] == 2
+        assert len(doc["history"]) == 1
+        assert doc["history"][0]["attempt"] == 1
+
+    def test_corrupt_baseline_starts_fresh(self, tmp_path):
+        (tmp_path / "perf_baseline.json").write_text("{nope")
+        b = PerfBaseline(tmp_path)  # must not raise
+        assert b.prior is None
+        assert b.compare(self.fp()) == []
+
+    def test_config_signature_fields(self):
+        from pytorch_ddp_template_tpu.config import TrainingConfig
+
+        sig = config_signature(TrainingConfig(mesh="data:4"), n_devices=4)
+        assert sig["mesh"] == "data:4"
+        assert sig["n_devices"] == 4
+        assert "model" in sig and "scan_layers" in sig
+
+
+# -- tools/bench_diff.py ---------------------------------------------------
+
+class TestBenchDiff:
+    def write(self, path, rows):
+        path.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+
+    def test_identical_passes(self, tmp_path, capsys):
+        a = tmp_path / "a.jsonl"
+        self.write(a, [{"metric": "m", "value": 2.0, "unit": "x"}])
+        assert bench_diff.main([str(a), str(a)]) == 0
+        assert "ok" in capsys.readouterr().out
+
+    def test_slowed_record_drifts(self, tmp_path, capsys):
+        a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+        self.write(a, [{"metric": "m", "value": 2.0}])
+        self.write(b, [{"metric": "m", "value": 1.0}])
+        assert bench_diff.main([str(a), str(b)]) == 1
+        out = capsys.readouterr()
+        assert "DRIFT" in out.out and "m" in out.err
+
+    def test_improvement_passes(self, tmp_path):
+        a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+        self.write(a, [{"metric": "m", "value": 2.0}])
+        self.write(b, [{"metric": "m", "value": 4.0}])
+        assert bench_diff.main([str(a), str(b)]) == 0
+
+    def test_github_format(self, tmp_path, capsys):
+        a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+        self.write(a, [{"metric": "m", "value": 2.0}])
+        self.write(b, [{"metric": "m", "value": 1.0}])
+        bench_diff.main([str(a), str(b), "--format", "github"])
+        out = capsys.readouterr().out
+        assert "| metric | base | new | ratio | status |" in out
+        assert "| `m` |" in out and "DRIFT" in out
+
+    def test_no_overlap_is_not_a_pass(self, tmp_path, capsys):
+        a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+        self.write(a, [{"metric": "m1", "value": 2.0}])
+        self.write(b, [{"metric": "m2", "value": 2.0}])
+        assert bench_diff.main([str(a), str(b)]) == 2
+
+    def test_ablation_and_error_rows_skipped(self, tmp_path):
+        a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+        self.write(a, [{"metric": "m", "value": 5.0, "remat": True},
+                       {"metric": "m", "value": 2.0},
+                       {"metric": "m", "value": 0.0, "error": "boom"}])
+        self.write(b, [{"metric": "m", "value": 2.0}])
+        # the ablation 5.0 must not define the bar: 2.0 vs 2.0 passes
+        assert bench_diff.main([str(a), str(b)]) == 0
+
+    def test_directories_merge(self, tmp_path):
+        d1, d2 = tmp_path / "d1", tmp_path / "d2"
+        d1.mkdir(), d2.mkdir()
+        self.write(d1 / "x.jsonl", [{"metric": "m", "value": 2.0}])
+        self.write(d1 / "y.jsonl", [{"metric": "m", "value": 3.0}])
+        self.write(d2 / "z.jsonl", [{"metric": "m", "value": 2.9}])
+        # best-of-side: 3.0 vs 2.9 — in band
+        assert bench_diff.main([str(d1), str(d2)]) == 0
+
+    def test_ablation_keys_pinned_to_bench(self):
+        import bench
+
+        assert tuple(bench_diff.ABLATION_KEYS) == tuple(bench.ABLATION_KEYS)
+
+
+# -- engine integration ----------------------------------------------------
+
+def make_trainer(out_dir, **overrides):
+    from pytorch_ddp_template_tpu.config import TrainingConfig
+    from pytorch_ddp_template_tpu.models import build
+    from pytorch_ddp_template_tpu.runtime import init as rt_init
+    from pytorch_ddp_template_tpu.train.engine import Trainer
+
+    cfg = TrainingConfig(**{
+        "model": "mlp", "mesh": "data:8",
+        "per_device_train_batch_size": 4, "dataset_size": 512,
+        "max_steps": 8, "logging_steps": 4, "save_steps": 0,
+        "resume": False, "warmup_steps": 0, "max_grad_norm": 1000.0,
+        "output_dir": str(out_dir), **overrides})
+    ctx = rt_init(cfg)
+    task, ds = build(cfg.model, cfg, mesh=ctx.mesh)
+    return Trainer(cfg, ctx, task, ds)
+
+
+class TestEngineFleet:
+    def test_status_endpoint_during_training(self, tmp_path):
+        """Integration: /status + /metrics + /healthz answer DURING a
+        real Trainer.train() run and the server dies with the run."""
+        t = make_trainer(tmp_path, fleet=True, status_port=-1,
+                         status_host="127.0.0.1",
+                         max_steps=60, logging_steps=2)
+        probes = {}
+
+        def probe():
+            while not probes.get("done"):
+                if t.status is not None and t.status.port:
+                    try:
+                        for route in ("/status", "/metrics", "/healthz"):
+                            code, body = _get(t.status.port, route)
+                            probes[route] = (code, body)
+                        if json.loads(probes["/status"][1])["step"] >= 2:
+                            return
+                    except Exception:  # noqa: BLE001 - retry next tick
+                        pass
+                time.sleep(0.02)
+
+        th = threading.Thread(target=probe)
+        th.start()
+        try:
+            t.train()
+        finally:
+            probes["done"] = True
+            th.join(timeout=30)
+        assert probes["/status"][0] == 200
+        snap = json.loads(probes["/status"][1])
+        assert snap["step"] >= 2
+        assert "progress" in snap["records"]
+        assert snap["describe"]["mesh"] == {"data": 8}
+        assert snap["goodput"]["attempt"] >= 1
+        assert (snap["fleet"]["table"] or {}).get("n_hosts") == 1
+        assert probes["/healthz"][0] == 200
+        assert "tpuddp_step" in probes["/metrics"][1]
+        # the server died with the run (connection refused, not frozen)
+        with pytest.raises(Exception):
+            _get(t.status.port, "/healthz")
+
+    def test_straggler_trigger_to_bundle_end_to_end(self, tmp_path):
+        """A faked slow peer in the fleet feed must ride the sentry into
+        a complete triage bundle whose trigger.json names the host —
+        and warn mode must NOT stop the run."""
+        from pytorch_ddp_template_tpu.obs.sentry import BUNDLE_FILES
+
+        t = make_trainer(tmp_path, fleet=True, anomaly="warn",
+                         max_steps=20, logging_steps=2,
+                         straggler_windows=2)
+        t.fleet._exchange = fake_fleet([5.0, 5.0, 42.0])
+        state = t.train()
+        assert int(state.step) == 20  # warn mode: the run completes
+        bundles = sorted((tmp_path / "flight_records").glob("step_*"))
+        assert len(bundles) == 1
+        names = {p.name for p in bundles[0].iterdir()}
+        assert set(BUNDLE_FILES) <= names
+        trig = json.loads((bundles[0] / "trigger.json").read_text())
+        assert trig["kind"] == "straggler"
+        assert trig["scalars"]["host"] == 2
+        assert trig["scalars"]["consecutive_windows"] == 2
+        assert "host 2" in trig["reasons"][0]
+        # satellite: the bundle records which host dumped and which host
+        # owns the trace — the straggler verdict is fleet-replicated, so
+        # only the NAMED host captures (this host defers: no profile/)
+        assert trig["host"] == 0
+        assert trig["trace_host"] == 2
+        assert "profile" not in names
+
+    def test_straggler_without_sentry_warns_only(self, tmp_path, monkeypatch):
+        """--fleet with --anomaly off: the verdict logs a warning but
+        produces no bundle (the sentry owns the triage machinery)."""
+        from pytorch_ddp_template_tpu.train import engine
+
+        warned = []
+        monkeypatch.setattr(
+            engine.log, "warning",
+            lambda msg, *a: warned.append(str(msg)))
+        t = make_trainer(tmp_path, fleet=True, anomaly="off",
+                         max_steps=12, logging_steps=2,
+                         straggler_windows=2)
+        t.fleet._exchange = fake_fleet([5.0, 5.0, 42.0])
+        t.train()
+        assert any("straggler" in w for w in warned)
+        assert not (tmp_path / "flight_records").exists()
+
+    def test_describe_json_written_unconditionally(self, tmp_path):
+        """Satellite: every run leaves the config+mesh+overlap snapshot
+        in output_dir — not only flight bundles."""
+        t = make_trainer(tmp_path)
+        t.train()
+        snap = json.loads((tmp_path / "describe.json").read_text())
+        assert snap["mesh"] == {"data": 8}
+        assert snap["config"]["model"] == "mlp"
+        assert snap["attempt"] == 1
+        assert "mesh" in snap["describe"]
+        assert snap["config"]["per_device_train_batch_size"] == 4
+
+    def test_metrics_schema_version_stamped(self, tmp_path):
+        """Satellite: every metrics.jsonl record carries schema_version
+        so bench_diff/external scrapers can evolve safely."""
+        from pytorch_ddp_template_tpu.train.metrics import SCHEMA_VERSION
+
+        t = make_trainer(tmp_path)
+        t.train()
+        recs = [json.loads(l) for l in
+                (tmp_path / "metrics.jsonl").read_text().splitlines()]
+        assert recs
+        assert all(r["schema_version"] == SCHEMA_VERSION for r in recs)
+
+    def test_perf_baseline_written_and_restore_compare_warns(
+            self, tmp_path, monkeypatch):
+        """The restore-compare path: attempt 1 writes
+        perf_baseline.json; a tampered (much faster) baseline makes
+        attempt 2 WARN with the regression delta."""
+        from pytorch_ddp_template_tpu.train import engine
+
+        t = make_trainer(tmp_path, max_steps=24, logging_steps=2)
+        t.train()
+        path = tmp_path / "perf_baseline.json"
+        doc = json.loads(path.read_text())
+        fp = doc["fingerprint"]
+        assert fp["attempt"] == 1
+        assert fp["step_time_p50_ms"] > 0
+        assert "config_sig" in fp
+
+        # tamper: claim the prior attempt was 100x faster
+        for k in list(fp):
+            if k.startswith("step_time"):
+                fp[k] = fp[k] / 100.0
+        path.write_text(json.dumps(doc))
+
+        warned = []
+        monkeypatch.setattr(
+            engine.log, "warning",
+            lambda msg, *a: warned.append(str(msg)))
+        t2 = make_trainer(tmp_path, max_steps=24, logging_steps=2)
+        t2.train()
+        regs = [w for w in warned if "perf regression" in w]
+        assert regs, "no regression warning on an out-of-band restart"
+        assert "step_time_p50_ms" in " ".join(regs)
+        # and attempt 2 rewrote the baseline with its own numbers
+        doc2 = json.loads(path.read_text())
+        assert doc2["fingerprint"]["step_time_p50_ms"] > fp["step_time_p50_ms"]
+        assert doc2["history"], "prior fingerprint must be kept"
+
+    def test_in_band_restart_is_silent(self, tmp_path, monkeypatch):
+        from pytorch_ddp_template_tpu.train import engine
+
+        t = make_trainer(tmp_path, max_steps=24, logging_steps=2)
+        t.train()
+        warned = []
+        monkeypatch.setattr(
+            engine.log, "warning",
+            lambda msg, *a: warned.append(str(msg)))
+        t2 = make_trainer(tmp_path, max_steps=24, logging_steps=2,
+                          regression_pct=400.0)  # huge band: never out
+        t2.train()
+        assert not any("perf regression" in w for w in warned)
+
+
+# -- config validation -----------------------------------------------------
+
+class TestConfigValidation:
+    def test_fleet_needs_a_cadence(self):
+        from pytorch_ddp_template_tpu.config import TrainingConfig
+
+        with pytest.raises(ValueError, match="cadence"):
+            TrainingConfig(fleet=True, logging_steps=0, perf_every=0)
+        TrainingConfig(fleet=True, logging_steps=0, perf_every=5)  # ok
+
+    def test_bounds(self):
+        from pytorch_ddp_template_tpu.config import TrainingConfig
+
+        with pytest.raises(ValueError, match="status_port"):
+            TrainingConfig(status_port=-2)
+        TrainingConfig(status_port=-1)  # ephemeral sentinel: valid
+        with pytest.raises(ValueError, match="straggler_threshold"):
+            TrainingConfig(straggler_threshold=0)
+        with pytest.raises(ValueError, match="straggler_windows"):
+            TrainingConfig(straggler_windows=0)
+        with pytest.raises(ValueError, match="regression_pct"):
+            TrainingConfig(regression_pct=0)
+
+    def test_cli_flags_parse(self):
+        from pytorch_ddp_template_tpu.config import parse_args
+
+        cfg = parse_args(["--fleet", "--status_port", "8090",
+                          "--straggler_threshold", "0.5",
+                          "--straggler_windows", "4",
+                          "--regression_pct", "10"])
+        assert cfg.fleet and cfg.status_port == 8090
+        assert cfg.straggler_threshold == 0.5
+        assert cfg.straggler_windows == 4
+        assert cfg.regression_pct == 10.0

@@ -407,6 +407,11 @@ def test_anomaly_halt_end_to_end(tmp_path):
     names = {p.name for p in bundles[0].iterdir()}
     assert set(BUNDLE_FILES) <= names
     assert "profile" in names  # the post-trigger TraceWindow capture
+    trig = json.loads((bundles[0] / "trigger.json").read_text())
+    # r14 satellite: the bundle records which host dumped and traced
+    # (an anomaly trigger traces wherever it fired)
+    assert trig["kind"] == "anomaly"
+    assert trig["host"] == 0 and trig["trace_host"] == 0
     ring = [json.loads(l)
             for l in (bundles[0] / "ring.jsonl").read_text().splitlines()]
     assert ring, "ring buffer must hold the pre-trigger history"

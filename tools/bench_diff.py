@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Diff two bench-record sets; exit non-zero on out-of-band drift.
+
+The 19 committed ``bench_records/*.jsonl`` files document every round's
+evidence — but documentation does not fail CI. This tool turns them into
+executable perf-regression tripwires (the r14 fleet-watchtower
+convention, the CLI sibling of ``obs/regression.py``):
+
+    # a fresh record vs the committed one (the BENCH_MODE=fleet leg)
+    python tools/bench_diff.py bench_records/perf_cpu_r13.jsonl /tmp/new.jsonl
+
+    # whole directories: every metric present in both sides is compared
+    python tools/bench_diff.py bench_records /tmp/fresh_records
+
+    # markdown for a PR comment / CI summary
+    python tools/bench_diff.py old.jsonl new.jsonl --format github
+
+Each side may be a ``.jsonl`` file or a directory of them. Records
+group by ``metric``; each side's best (max-value) non-ablation record
+represents the metric (the ``_last_recorded`` convention: a
+deliberately degraded config must not define the bar). Every bench
+metric in this repo is higher-is-better (throughputs, speedups, and
+the ≥0.9 neutrality-band ratios), so drift means
+``new < base * (1 - tolerance)``. Improvements report as OK.
+
+Exit codes: 0 in-band, 1 drift, 2 usage/no-overlap (an empty comparison
+must not read as a green tripwire).
+
+Stdlib-only on purpose: runs anywhere, including hosts with no jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+#: record keys that mark an ablation run (mirrors bench.py; duplicated
+#: rather than imported so this tool stays importable without the repo
+#: root on sys.path — the two lists are pinned equal by a test)
+ABLATION_KEYS = ("remat", "fused_head", "dense_head", "flash_disabled",
+                 "num_layers", "scan_layers", "ddp_overlap", "tp_overlap",
+                 "fsdp_overlap")
+
+
+def _paths(target: str) -> list[str]:
+    if os.path.isdir(target):
+        return sorted(glob.glob(os.path.join(target, "*.jsonl")))
+    return [target]
+
+
+def load_records(target: str) -> dict[str, dict]:
+    """``metric -> best record`` over a file or directory of JSONL
+    records. Error rows (``value`` 0/absent) and ablation rows are
+    skipped — the bar is the best honest number."""
+    best: dict[str, dict] = {}
+    for path in _paths(target):
+        try:
+            lines = open(path).read().splitlines()
+        except OSError:
+            continue
+        for line in lines:
+            try:
+                rec = json.loads(line)
+            except (ValueError, TypeError):
+                continue
+            metric = rec.get("metric")
+            value = rec.get("value")
+            if not metric or not isinstance(value, (int, float)) or value <= 0:
+                continue
+            if any(rec.get(k) for k in ABLATION_KEYS):
+                continue
+            rec = dict(rec)
+            rec["_source"] = os.path.basename(path)
+            if metric not in best or value > best[metric]["value"]:
+                best[metric] = rec
+    return best
+
+
+def diff_records(base: dict[str, dict], new: dict[str, dict], *,
+                 tolerance: float) -> list[dict]:
+    """One row per metric present in BOTH sides, ratio = new/base,
+    ``drift`` when the new value fell out of band."""
+    rows = []
+    for metric in sorted(set(base) & set(new)):
+        b, n = base[metric]["value"], new[metric]["value"]
+        ratio = n / b if b else 0.0
+        rows.append({
+            "metric": metric,
+            "unit": new[metric].get("unit") or base[metric].get("unit"),
+            "base": b,
+            "new": n,
+            "ratio": round(ratio, 4),
+            "drift": ratio < 1.0 - tolerance,
+            "base_source": base[metric].get("_source"),
+            "new_source": new[metric].get("_source"),
+        })
+    return rows
+
+
+def render(rows: list[dict], fmt: str, *, tolerance: float) -> str:
+    """``text`` (aligned columns) or ``github`` (markdown table)."""
+    status = lambda r: "DRIFT" if r["drift"] else "ok"  # noqa: E731
+    if fmt == "github":
+        out = [
+            f"### bench_diff (band: new ≥ {1 - tolerance:.2f}× base)",
+            "",
+            "| metric | base | new | ratio | status |",
+            "|---|---:|---:|---:|---|",
+        ]
+        for r in rows:
+            mark = "❌ DRIFT" if r["drift"] else "✅ ok"
+            out.append(f"| `{r['metric']}` | {r['base']:g} | {r['new']:g} "
+                       f"| {r['ratio']:.3f} | {mark} |")
+        return "\n".join(out)
+    width = max([len(r["metric"]) for r in rows] + [6])
+    out = [f"{'metric':<{width}}  {'base':>12}  {'new':>12}  "
+           f"{'ratio':>7}  status"]
+    for r in rows:
+        out.append(f"{r['metric']:<{width}}  {r['base']:>12g}  "
+                   f"{r['new']:>12g}  {r['ratio']:>7.3f}  {status(r)}")
+    return "\n".join(out)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("base", help="baseline .jsonl file or directory "
+                                "(e.g. bench_records)")
+    p.add_argument("new", help="candidate .jsonl file or directory")
+    p.add_argument("--tolerance", type=float, default=0.25,
+                   help="allowed relative drop before a metric counts as "
+                        "drift (0.25 = the new value may be up to 25%% "
+                        "below the base; generous by default — CPU bench "
+                        "numbers carry ambient noise)")
+    p.add_argument("--format", choices=["text", "github"], default="text",
+                   help="'github' emits a markdown table for PR/CI "
+                        "summaries")
+    args = p.parse_args(argv)
+    if not (0.0 < args.tolerance < 1.0):
+        print(f"--tolerance must be in (0, 1), got {args.tolerance}",
+              file=sys.stderr)
+        return 2
+
+    base = load_records(args.base)
+    new = load_records(args.new)
+    rows = diff_records(base, new, tolerance=args.tolerance)
+    if not rows:
+        # zero overlap is NOT a pass: a renamed metric or an empty file
+        # would otherwise silently disarm the tripwire
+        print(f"no common metrics between {args.base!r} ({len(base)} "
+              f"metrics) and {args.new!r} ({len(new)} metrics)",
+              file=sys.stderr)
+        return 2
+    print(render(rows, args.format, tolerance=args.tolerance))
+    drifted = [r["metric"] for r in rows if r["drift"]]
+    if drifted:
+        print(f"DRIFT: {len(drifted)}/{len(rows)} metrics out of band "
+              f"(> {100 * args.tolerance:g}% below base): "
+              + ", ".join(drifted), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
