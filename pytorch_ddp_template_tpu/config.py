@@ -246,6 +246,33 @@ class TrainingConfig:
     #                               prior attempt's perf_baseline.json
     #                               by more than this percentage WARNs
     #                               with the delta
+    mem_report: bool = False  # memory X-ray (obs/memory.py): ride the
+    #                           startup AOT compile (shared with
+    #                           --perf_report/--hlo_report) for a
+    #                           compile-time memory split
+    #                           (memory_analysis: argument/output/temp/
+    #                           code/aliased bytes) + a donation audit
+    #                           that WARNs on undonated train-state
+    #                           leaves (a silently doubled state
+    #                           footprint); poll device.memory_stats()
+    #                           on the telemetry drain thread at the
+    #                           perf/logging cadence into kind="mem"
+    #                           records (per-device bytes-in-use/peak/
+    #                           limit, rolling watermark, per-phase peak
+    #                           attribution — backends without
+    #                           memory_stats degrade to the static
+    #                           model, never an invented watermark);
+    #                           feed the sentry a mem_pressure trigger
+    #                           when the watermark crosses the budget;
+    #                           attach memory forensics (live-buffer
+    #                           census + the split + last K records) to
+    #                           flight bundles. Opt-in: costs one AOT
+    #                           compile at startup, like its siblings
+    mem_budget_frac: float = 0.9  # capacity tripwire bar: projected/
+    #                               measured peak HBM above this
+    #                               fraction of the device limit WARNs
+    #                               at startup and triggers the sentry
+    #                               (kind="mem_pressure") at runtime
     hlo_report: bool = False  # compile the train step ahead of the loop
     #                           and write an HLO schedule report
     #                           (obs/hlo_report.py) to
@@ -342,6 +369,19 @@ class TrainingConfig:
         if self.regression_pct <= 0:
             raise ValueError(
                 f"--regression_pct must be > 0, got {self.regression_pct}"
+            )
+        if not (0.0 < self.mem_budget_frac <= 1.0):
+            raise ValueError(
+                f"--mem_budget_frac must be in (0, 1], got "
+                f"{self.mem_budget_frac} (a fraction of the device HBM "
+                "limit, e.g. 0.9 = warn at 90%)"
+            )
+        if self.mem_report and not (self.logging_steps or self.perf_every):
+            raise ValueError(
+                "--mem_report polls the HBM watermark at the perf/logging "
+                "cadence, but both --logging_steps and --perf_every are 0 "
+                "— set one of them or drop --mem_report (a cadence-less "
+                "watermark never samples)"
             )
         if self.fleet and not (self.logging_steps or self.perf_every):
             raise ValueError(
@@ -754,6 +794,30 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "lower) than the prior attempt's "
                         "perf_baseline.json by more than this "
                         "percentage logs a WARNING with the delta.")
+    p.add_argument("--mem_report", action="store_true",
+                   help="Memory X-ray (obs/memory.py): compile-time "
+                        "memory split (argument/output/temp/code/aliased "
+                        "bytes from memory_analysis) + donation audit "
+                        "(WARNs on undonated train-state leaves — a "
+                        "silently doubled state footprint) off the "
+                        "startup AOT compile (shared with "
+                        "--perf_report/--hlo_report); a runtime HBM "
+                        "watermark poller on the telemetry drain thread "
+                        "(kind=\"mem\" records: per-device bytes-in-use/"
+                        "peak/limit, rolling watermark, per-phase peak "
+                        "attribution; backends without memory_stats "
+                        "degrade to the static model); a capacity "
+                        "tripwire at --mem_budget_frac of the device "
+                        "limit (startup WARN + sentry mem_pressure "
+                        "trigger); and memory forensics (live-buffer "
+                        "census + the split + last K mem records) in "
+                        "flight bundles. Costs one extra AOT compile at "
+                        "startup.")
+    p.add_argument("--mem_budget_frac", type=float, default=0.9,
+                   help="Capacity tripwire bar: projected/measured peak "
+                        "HBM above this fraction of the device limit "
+                        "warns at startup and feeds the sentry a "
+                        "mem_pressure trigger at runtime (default 0.9).")
     p.add_argument("--hlo_report", action="store_true",
                    help="Compile the train step ahead of the loop and "
                         "write obs/hlo_report.py's schedule report to "

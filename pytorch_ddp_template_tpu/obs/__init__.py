@@ -1,7 +1,7 @@
-"""Observability subsystem (rounds 12-14): the training loop watching
+"""Observability subsystem (rounds 12-15): the training loop watching
 itself.
 
-Eight coordinated pieces:
+Nine coordinated pieces:
 
 - :mod:`.health` — in-step device-side health scalars (param/update
   norms, non-finite counts, per-layer grad norms, EF-residual norm)
@@ -20,6 +20,12 @@ Eight coordinated pieces:
   run (productive / compile / checkpoint / restore / input-stall /
   halted), persisted to ``goodput.json`` and accumulated across
   restarts;
+- :mod:`.memory` — the r15 memory X-ray: compile-time memory split +
+  donation audit off the startup AOT compile, the runtime HBM watermark
+  poller (``kind="mem"`` records on the drain thread, per-phase peak
+  attribution), the ``--mem_budget_frac`` capacity tripwire feeding the
+  sentry as ``mem_pressure``, and the live-buffer-census forensics
+  attached to flight bundles on OOM;
 - :mod:`.fleet` — the r14 fleet watchtower: periodic cross-host
   exchange of host-side signals at the logging cadence (on the
   telemetry drain thread), min/median/max fleet tables, and the
@@ -60,6 +66,18 @@ _EXPORTS = {
     ),
     "goodput": ("BUCKETS", "GoodputLedger"),
     "health": ("HEALTH_KEYS", "health_metrics"),
+    "memory": (
+        "MEM_RING",
+        "MemoryMonitor",
+        "compile_memory_split",
+        "device_memory_rows",
+        "donation_audit",
+        "donation_warnings",
+        "forensics_payload",
+        "live_buffer_census",
+        "looks_like_oom",
+        "static_memory_model",
+    ),
     "regression": (
         "PerfBaseline",
         "compare_fingerprints",

@@ -73,6 +73,13 @@ FLEET_WIRE_KEYS = (
     "gp_productive_s",    # goodput ledger delta: productive seconds
     "gp_wall_s",          # goodput ledger delta: total seconds
     "anomaly",            # 1.0 when this host's sentry has triggered
+    # -- r15 memory columns (appended at the END per the mixed-version
+    #    tolerance above: an old peer's shorter row zero-fills these) --
+    "mem_bytes_in_use",   # latest HBM bytes in use (max over local
+    #                       devices; 0.0 when the backend reports none —
+    #                       a host leaking memory is a straggler-to-be)
+    "mem_frac_of_limit",  # that figure over the device limit (0.0
+    #                       when unmeasured)
 )
 
 #: signals the fleet table summarises with min/median/max (step is an

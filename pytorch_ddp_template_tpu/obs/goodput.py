@@ -83,8 +83,20 @@ class GoodputLedger:
             if (not prior.get("completed")
                     and isinstance(last, (int, float)) and last > 0):
                 gap = self._t_start - float(last)
-                if gap > 0:
-                    self._prior["halted"] += gap
+                if gap < 0:
+                    # wall clocks are not monotonic across hosts or
+                    # reboots: a restart on a clock-skewed host can see
+                    # the prior heartbeat in the FUTURE. Booking that
+                    # negative gap would corrupt the halted bucket (and
+                    # every ratio derived from the bucket sum) — clamp
+                    # to 0 and say so once
+                    log.warning(
+                        "goodput: prior attempt's last heartbeat is "
+                        f"{-gap:.1f}s in the future (clock skew between "
+                        "hosts/reboots?); booking 0s of halted downtime "
+                        "for this restart instead of a negative gap")
+                    gap = 0.0
+                self._prior["halted"] += gap
 
     def _load_prior(self) -> dict[str, Any] | None:
         try:

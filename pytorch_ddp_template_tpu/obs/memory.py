@@ -1,0 +1,598 @@
+"""Memory X-ray: HBM accounting, live-buffer forensics, capacity tripwires.
+
+The fourth observability layer (r15). r12 answers "is the step healthy?",
+r13 "where does the time go?", r14 "which host is sick?" — this module
+answers "**where do the bytes go, and how close is the run to the HBM
+cliff?**". The question decides what is *runnable* long before FLOPs do
+(the remat lineage: Chen et al. 2016, "Training Deep Nets with Sublinear
+Memory Cost" — the compile-time memory plan, not the compute, picks the
+feasible configurations), and every open ROADMAP item is memory-gated:
+a paged KV cache is sized against real headroom, reshard-on-restore must
+pick a mesh that *fits*, and the int8-KV claim is a memory number the
+production loop previously could not measure at all (the only in-tree
+memory evidence was bench-only ``memory_analysis`` live-range checks,
+r8/r10).
+
+Three coordinated pieces:
+
+- **Compile-time memory report** (:func:`static_memory_model`, riding the
+  existing ``_startup_reports`` AOT compile under ``--mem_report`` /
+  ``--perf_report`` / ``--hlo_report``): ``compiled.memory_analysis()``
+  split into argument / output / temp / generated-code / aliased bytes
+  plus the projected per-device peak, cross-referenced with a **donation
+  audit** (:func:`donation_audit`) that walks the jitted step's
+  ``lowered.args_info`` and names every train-state leaf that is NOT
+  donated — an undonated state is a silently *doubled* resident state
+  footprint (old + new buffers live across the step). The audit also
+  cross-checks XLA's realised aliasing (``alias_size_in_bytes``) against
+  the donated bytes: donation *requested* but not *honoured* (layout
+  mismatch) is the same doubling wearing a quieter hat.
+- **Runtime HBM watermark** (:class:`MemoryMonitor`): polls
+  ``device.memory_stats()`` on the telemetry **drain thread** (the r6/r14
+  contract — nothing on the hot loop) at the perf/logging cadence,
+  emitting ``kind="mem"`` records with per-device bytes-in-use / peak /
+  limit, a rolling high watermark, and a **per-phase peak attribution**
+  sampled against the r13 named loop phases
+  (``utils/profiler.current_phase``). Backends without ``memory_stats``
+  (CPU) degrade to the static compile-time model — reported as the
+  *projection* it is, never dressed up as a measurement.
+- **Capacity tripwires + forensics**: projected peak above
+  ``--mem_budget_frac`` (default 0.9) of the device limit logs a named
+  warning at startup; a *measured* watermark above the same budget feeds
+  the r12 sentry as an ``external_trigger(kind="mem_pressure")`` (one
+  verdict per pressure episode, re-armed on recovery — the r14 straggler
+  convention), so the standard triage bundle lands with the numbers in
+  ``trigger.json``. An allocation-failure/OOM exception in the loop dumps
+  a **memory forensics bundle** through the existing flight-recorder
+  machinery: a live-buffer census (:func:`live_buffer_census` over
+  ``jax.live_arrays()``, bucketed by shape × dtype × sharding), the
+  compile-time split, and the last K ``mem`` records.
+
+Honesty discipline (the r13 convention): every figure is labelled with
+its provenance (``mem_measured`` 1.0 = ``memory_stats``, 0.0 = the static
+model), missing backend support yields *no* figure rather than an
+invented one, and the census reports logical (global) bytes per array —
+the per-device share is the sharding's business, recorded next to it.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Callable
+
+from ..utils.logging import get_logger
+
+log = get_logger(__name__)
+
+#: ``kind="mem"`` records kept for the forensics bundle (the flight-
+#: recorder ring convention: the last K, not a session)
+MEM_RING = 64
+
+#: census buckets reported (largest-bytes first); the tail is summed,
+#: never silently dropped
+CENSUS_TOP = 64
+
+#: message fragments that mark an exception as an allocation failure —
+#: the forensics-bundle trigger (PJRT spells OOM several ways). The
+#: bare "OOM" acronym is matched on word boundaries only (below): a
+#: crash merely *mentioning* BLOOM or ZOOM must not get memory triage
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "RESOURCE EXHAUSTED", "Out of memory",
+                "out of memory", "Failed to allocate",
+                "Allocation failure", "exceeds the memory capacity")
+
+_OOM_WORD = None  # compiled lazily; regex import kept off the hot path
+
+
+def looks_like_oom(exc: BaseException) -> bool:
+    """True when ``exc`` smells like an allocation failure (a
+    ``MemoryError``, or a runtime error carrying one of the PJRT/XLA
+    OOM spellings) — the gate for dumping memory forensics into a crash
+    bundle even when no :class:`MemoryMonitor` is configured."""
+    if isinstance(exc, MemoryError):
+        return True
+    try:
+        msg = f"{type(exc).__name__}: {exc}"
+    except Exception:  # noqa: BLE001 - a broken __str__ on the crashing
+        #               exception must not mask the crash (this helper
+        #               runs inside the engine's crash handler, BEFORE
+        #               its best-effort dump guard)
+        return False
+    if any(m in msg for m in _OOM_MARKERS):
+        return True
+    global _OOM_WORD
+    if _OOM_WORD is None:
+        import re
+
+        _OOM_WORD = re.compile(r"\bOOM\b")
+    return _OOM_WORD.search(msg) is not None
+
+
+# -- compile-time accounting ------------------------------------------------
+
+def compile_memory_split(compiled) -> dict[str, Any] | None:
+    """The executable's own memory plan, split the way XLA accounts it:
+    ``compiled.memory_analysis()`` → argument / output / temp /
+    generated-code / aliased bytes plus the projected resident peak
+    (arguments + outputs − aliased + temps + code: aliased output bytes
+    reuse their argument's buffer, so they count once). Per-device
+    figures — the executable is the per-device program.
+
+    Returns None when the backend exposes no analysis (best-effort by
+    the same rule as :func:`obs.attribution.cost_of`): **no figure is
+    ever invented**.
+    """
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 - not all PJRT backends implement it
+        return None
+    if ma is None:
+        return None
+    fields = {
+        "argument_bytes": "argument_size_in_bytes",
+        "output_bytes": "output_size_in_bytes",
+        "temp_bytes": "temp_size_in_bytes",
+        "generated_code_bytes": "generated_code_size_in_bytes",
+        "alias_bytes": "alias_size_in_bytes",
+    }
+    out: dict[str, Any] = {}
+    for key, attr in fields.items():
+        v = getattr(ma, attr, None)
+        if v is None:
+            return None  # a partial analysis is not an analysis
+        out[key] = int(v)
+    out["projected_peak_bytes"] = (
+        out["argument_bytes"] + out["output_bytes"] - out["alias_bytes"]
+        + out["temp_bytes"] + out["generated_code_bytes"])
+    return out
+
+
+def _leaf_bytes(info: Any) -> int:
+    """Byte size of one ``ArgInfo`` leaf (0 when the aval is opaque)."""
+    import numpy as np
+
+    aval = getattr(info, "aval", None) or getattr(info, "_aval", None)
+    try:
+        return int(aval.size) * int(np.dtype(aval.dtype).itemsize)
+    except Exception:  # noqa: BLE001
+        return 0
+
+
+def donation_audit(args_info, donate_argnums: tuple[int, ...] = (0,),
+                   max_paths: int = 16) -> dict[str, Any]:
+    """Walk the jitted step's ``lowered.args_info`` and account buffer
+    donation over the arguments in ``donate_argnums`` (the train state is
+    argument 0 by the ``make_train_step`` contract).
+
+    A train-state leaf that is **not** donated keeps its input buffer
+    alive across the step while the output allocates a fresh one — the
+    state footprint silently doubles. The audit names such leaves
+    (bounded by ``max_paths``) so the engine can WARN with the paths, not
+    just a count. ``args_info`` may be None (older jax, wrapped steps):
+    the audit then reports itself unavailable instead of guessing.
+    """
+    if args_info is None:
+        return {"available": False}
+    import jax.tree_util as jtu
+
+    try:
+        donated = undonated = 0
+        donated_bytes = undonated_bytes = 0
+        paths: list[str] = []
+        for argnum in donate_argnums:
+            subtree = args_info[0][argnum]
+            for path, info in jtu.tree_leaves_with_path(subtree):
+                nbytes = _leaf_bytes(info)
+                if getattr(info, "donated", False):
+                    donated += 1
+                    donated_bytes += nbytes
+                else:
+                    undonated += 1
+                    undonated_bytes += nbytes
+                    if len(paths) < max_paths:
+                        paths.append(jtu.keystr(path))
+        return {
+            "available": True,
+            "donated_leaves": donated,
+            "donated_bytes": donated_bytes,
+            "undonated_leaves": undonated,
+            "undonated_bytes": undonated_bytes,
+            "undonated_paths": paths,
+        }
+    except Exception:  # noqa: BLE001 - an audit must never cost the run
+        log.exception("donation audit failed")
+        return {"available": False}
+
+
+def static_memory_model(compiled, args_info=None,
+                        donate_argnums: tuple[int, ...] = (0,)
+                        ) -> dict[str, Any]:
+    """The compile-time memory report: the :func:`compile_memory_split`
+    plus the :func:`donation_audit`, cross-referenced — ``donation_honoured``
+    is False when donation was *requested* for more bytes than XLA
+    actually aliased (``alias_bytes`` well short of ``donated_bytes``
+    means a layout/sharding mismatch quietly kept both buffers live).
+    JSON-ready; never raises."""
+    split = compile_memory_split(compiled)
+    audit = donation_audit(args_info, donate_argnums)
+    model: dict[str, Any] = {
+        "available": split is not None,
+        "split": split,
+        "donation": audit,
+    }
+    if split is not None and audit.get("available"):
+        requested = audit["donated_bytes"]
+        # tolerance: padding/layout can legally shave a few percent
+        model["donation_honoured"] = bool(
+            requested == 0 or split["alias_bytes"] >= 0.5 * requested)
+    return model
+
+
+def donation_warnings(model: dict[str, Any]) -> list[str]:
+    """Human warning strings for a :func:`static_memory_model` whose
+    donation story doubles the state footprint (empty = clean)."""
+    warnings: list[str] = []
+    audit = model.get("donation") or {}
+    if audit.get("available") and audit.get("undonated_leaves", 0) > 0:
+        warnings.append(
+            f"donation audit: {audit['undonated_leaves']} train-state "
+            f"leaves ({audit['undonated_bytes'] / 1e6:.1f} MB) are NOT "
+            "donated — the old and new state buffers both stay resident "
+            "across the step (a silently doubled state footprint); "
+            "first paths: " + ", ".join(audit.get("undonated_paths", [])))
+    if model.get("donation_honoured") is False:
+        split = model.get("split") or {}
+        warnings.append(
+            "donation audit: donation was requested for "
+            f"{(audit.get('donated_bytes') or 0) / 1e6:.1f} MB but XLA "
+            f"aliased only {split.get('alias_bytes', 0) / 1e6:.1f} MB — "
+            "unhonoured donation (layout/sharding mismatch?) keeps both "
+            "buffers live, same doubled footprint")
+    return warnings
+
+
+# -- live-buffer forensics --------------------------------------------------
+
+def live_buffer_census(arrays=None, top: int = CENSUS_TOP) -> dict[str, Any]:
+    """Bucket the process's live jax arrays by (shape, dtype, sharding):
+    the "where did the bytes go" answer an OOM post-mortem starts from.
+
+    ``bytes`` per bucket is the *logical* (global) array size — under a
+    sharded runtime each device holds its shard; the sharding string
+    next to it says how to divide. Buckets beyond ``top`` are summed
+    into ``truncated`` (bounded output, nothing silently dropped).
+    Never raises; arrays deleted mid-walk are skipped.
+    """
+    if arrays is None:
+        import jax
+
+        try:
+            arrays = jax.live_arrays()
+        except Exception:  # noqa: BLE001
+            return {"available": False, "n_arrays": 0, "total_bytes": 0,
+                    "buckets": []}
+    buckets: dict[tuple, dict[str, Any]] = {}
+    n = 0
+    total = 0
+    for a in arrays:
+        try:
+            if getattr(a, "is_deleted", lambda: False)():
+                continue
+            sharding = getattr(a, "sharding", None)
+            spec = getattr(sharding, "spec", None)
+            sh = (str(spec) if spec is not None
+                  else type(sharding).__name__ if sharding is not None
+                  else "unknown")
+            key = (str(tuple(a.shape)), str(a.dtype), sh)
+            nbytes = int(a.nbytes)
+        except Exception:  # noqa: BLE001 - a half-dead array is not news
+            continue
+        n += 1
+        total += nbytes
+        b = buckets.setdefault(key, {
+            "shape": key[0], "dtype": key[1], "sharding": key[2],
+            "count": 0, "bytes": 0})
+        b["count"] += 1
+        b["bytes"] += nbytes
+    ordered = sorted(buckets.values(), key=lambda b: -b["bytes"])
+    head, tail = ordered[:top], ordered[top:]
+    return {
+        "available": True,
+        "n_arrays": n,
+        "total_bytes": total,
+        "buckets": head,
+        "truncated": {
+            "buckets": len(tail),
+            "bytes": sum(b["bytes"] for b in tail),
+        } if tail else None,
+    }
+
+
+# -- runtime watermark ------------------------------------------------------
+
+def device_memory_rows(devices) -> list[dict[str, Any]] | None:
+    """Per-device HBM stats via ``device.memory_stats()`` — one row per
+    device that reports them, None when **no** device does (the CPU
+    backend): the caller degrades to the static model rather than
+    publishing zeros as a measurement."""
+    rows: list[dict[str, Any]] = []
+    for i, d in enumerate(devices):
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 - per-device, not per-backend
+            stats = None
+        if not stats:
+            continue
+        rows.append({
+            "device": i,
+            "kind": getattr(d, "device_kind", "unknown"),
+            "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+            "peak_bytes_in_use": int(stats.get(
+                "peak_bytes_in_use", stats.get("bytes_in_use", 0))),
+            "bytes_limit": int(stats.get("bytes_limit", 0)),
+        })
+    return rows or None
+
+
+class MemoryMonitor:
+    """Runtime HBM watermark + capacity tripwire + forensics source.
+
+    Threading contract (the r12/r14 pattern): :meth:`observe` runs on
+    the telemetry drain thread (``kind="mem"`` records route here — the
+    poll is host-side PJRT bookkeeping, not a device computation, but it
+    still does not belong on the hot loop); ``state()``/``forensics()``
+    read under the same lock from any thread. ``poll`` is injectable
+    (tests and the bench's injected-pressure leg fake a device's
+    ``memory_stats``); the default reads this process's local devices.
+    ``on_pressure(step, verdict)`` fires ONCE per pressure episode on
+    the drain thread — the engine points it at the sentry's
+    ``external_trigger(kind="mem_pressure")``.
+    """
+
+    def __init__(self, devices=(), *, budget_frac: float = 0.9,
+                 on_pressure: Callable[[int, dict[str, Any]], None]
+                 | None = None,
+                 poll: Callable[[], list[dict[str, Any]] | None]
+                 | None = None,
+                 ring: int = MEM_RING):
+        if not (0.0 < budget_frac <= 1.0):
+            raise ValueError(f"mem budget_frac must be in (0, 1], got "
+                             f"{budget_frac}")
+        self.devices = list(devices)
+        self.budget_frac = float(budget_frac)
+        self.on_pressure = on_pressure
+        self._poll = poll or (lambda: device_memory_rows(self.devices))
+        self._lock = threading.Lock()
+        self._ring: deque[dict[str, Any]] = deque(maxlen=max(int(ring), 8))
+        #: the compile-time model (set by the engine's _startup_reports
+        #: when the AOT compile ran; None = runtime-only monitoring)
+        self.static_model: dict[str, Any] | None = None
+        self.watermark_bytes = 0.0   # max bytes_in_use observed
+        self.peak_bytes = 0.0        # max backend-reported peak
+        self.limit_bytes: float | None = None
+        self.phase_peaks: dict[str, float] = {}
+        self.polls = 0
+        self._pressure_active = False
+        self._static_logged = False
+        self._last_rows: list[dict[str, Any]] | None = None
+
+    def set_static_model(self, model: dict[str, Any] | None) -> None:
+        with self._lock:
+            self.static_model = model
+
+    # -- drain-thread side -------------------------------------------------
+    def observe(self, step: int, scalars: dict[str, Any] | None = None
+                ) -> dict[str, Any] | None:
+        """One watermark sample; returns the flat ``mem`` record for the
+        metrics writer (None when there is nothing honest to report).
+        Never raises."""
+        del scalars  # the loop's emit carries no payload; the poll is here
+        try:
+            return self._observe(int(step))
+        except Exception:  # noqa: BLE001 - the watchtower must never
+            #               kill the telemetry drain
+            log.exception("mem record dropped")
+            return None
+
+    def _observe(self, step: int) -> dict[str, Any] | None:
+        from ..utils.profiler import current_phase
+
+        phase = current_phase()
+        rows = self._poll()
+        rec: dict[str, Any] = {}
+        verdict: dict[str, Any] | None = None
+        with self._lock:
+            self.polls += 1
+            if rows:
+                self._last_rows = rows
+                in_use = max(r["bytes_in_use"] for r in rows)
+                peak = max(r["peak_bytes_in_use"] for r in rows)
+                limits = [r["bytes_limit"] for r in rows
+                          if r["bytes_limit"] > 0]
+                limit = min(limits) if limits else None
+                self.watermark_bytes = max(self.watermark_bytes,
+                                           float(in_use))
+                self.peak_bytes = max(self.peak_bytes, float(peak))
+                if limit is not None:
+                    self.limit_bytes = float(limit)
+                self.phase_peaks[phase] = max(
+                    self.phase_peaks.get(phase, 0.0), float(in_use))
+                import numpy as np
+
+                rec = {
+                    "mem_measured": 1.0,
+                    "mem_bytes_in_use": float(in_use),
+                    "mem_peak_bytes": float(peak),
+                    "mem_watermark_bytes": self.watermark_bytes,
+                    # per-device vector: as an ndarray it rides the
+                    # JSONL-only vector channel (the per_layer_grad_norm
+                    # convention — a Python list would be MEANED by the
+                    # sink's loss-window rule)
+                    "mem_bytes_in_use_per_device": np.asarray(
+                        [float(r["bytes_in_use"]) for r in rows]),
+                }
+                if limit is not None:
+                    frac = in_use / limit
+                    rec["mem_limit_bytes"] = float(limit)
+                    rec["mem_frac_of_limit"] = round(frac, 4)
+                    bar = self.budget_frac
+                    if frac > bar and not self._pressure_active:
+                        # one verdict per pressure episode; re-armed on
+                        # recovery below the bar (the r14 straggler
+                        # convention — an hour of pressure is one
+                        # bundle, not one per cadence tick)
+                        self._pressure_active = True
+                        worst = max(rows,
+                                    key=lambda r: r["bytes_in_use"])
+                        verdict = {
+                            "bytes_in_use": int(in_use),
+                            "bytes_limit": int(limit),
+                            "frac_of_limit": round(frac, 4),
+                            "budget_frac": bar,
+                            "device": int(worst["device"]),
+                            "watermark_bytes": int(self.watermark_bytes),
+                            "phase": phase,
+                        }
+                    elif frac <= bar:
+                        self._pressure_active = False
+            else:
+                # degrade to the compile-time model: report the
+                # PROJECTION as a projection (mem_measured 0.0), or
+                # nothing at all when no model exists — never a fake 0B
+                # watermark
+                split = (self.static_model or {}).get("split")
+                if not split:
+                    return None
+                if not self._static_logged:
+                    self._static_logged = True
+                    log.info(
+                        "device memory_stats unavailable on this backend; "
+                        "mem records carry the static compile-time model "
+                        "only (logged once)")
+                rec = {
+                    "mem_measured": 0.0,
+                    "mem_projected_peak_bytes":
+                        float(split["projected_peak_bytes"]),
+                    "mem_temp_bytes": float(split["temp_bytes"]),
+                    "mem_argument_bytes": float(split["argument_bytes"]),
+                }
+            self._ring.append({"step": step, "phase": phase, **rec})
+        if verdict is not None and self.on_pressure is not None:
+            self.on_pressure(step, verdict)
+        return rec
+
+    # -- tripwires ---------------------------------------------------------
+    def startup_warnings(self) -> list[str]:
+        """The compile-time capacity tripwire: projected peak (static
+        model, plus any already-measured baseline in-use) against the
+        device limit. Empty when no limit is known (CPU) or the budget
+        holds — a missing limit is never treated as a pass *or* a fail,
+        it is simply unmeasurable."""
+        with self._lock:
+            split = (self.static_model or {}).get("split")
+            limit = self.limit_bytes
+            baseline = self.watermark_bytes
+        if not split:
+            return []
+        if limit is None:
+            rows = self._poll()
+            if rows:
+                limits = [r["bytes_limit"] for r in rows
+                          if r["bytes_limit"] > 0]
+                limit = min(limits) if limits else None
+                baseline = max((r["bytes_in_use"] for r in rows),
+                               default=0.0)
+        if not limit:
+            return []
+        projected = split["projected_peak_bytes"] + max(
+            baseline - split["argument_bytes"], 0.0)
+        frac = projected / limit
+        if frac <= self.budget_frac:
+            return []
+        return [
+            f"memory budget tripwire: projected peak "
+            f"{projected / 1e9:.2f} GB is {100 * frac:.1f}% of the "
+            f"{limit / 1e9:.2f} GB device limit (budget "
+            f"--mem_budget_frac={self.budget_frac:g}) — args "
+            f"{split['argument_bytes'] / 1e9:.2f} GB + temps "
+            f"{split['temp_bytes'] / 1e9:.2f} GB + outputs/code; an "
+            "allocation failure mid-run is likely (shrink the batch, "
+            "enable --remat, or shard further)"]
+
+    # -- consumers ---------------------------------------------------------
+    def peak_hbm_bytes(self) -> float | None:
+        """The figure stamped into ``perf_baseline.json``: the measured
+        watermark when one exists, else the static projection, else
+        None (never invented)."""
+        with self._lock:
+            if self.peak_bytes > 0:
+                return float(self.peak_bytes)
+            if self.watermark_bytes > 0:
+                return float(self.watermark_bytes)
+            split = (self.static_model or {}).get("split")
+            if split:
+                return float(split["projected_peak_bytes"])
+        return None
+
+    def wire_signals(self) -> dict[str, float]:
+        """This host's memory columns for the fleet wire vector (zeros
+        when unmeasured — the documented zero-fill tolerance; a host
+        leaking memory is a straggler-to-be, so the fleet table wants
+        these next to the step walls)."""
+        with self._lock:
+            last = self._ring[-1] if self._ring else {}
+            return {
+                "mem_bytes_in_use": float(
+                    last.get("mem_bytes_in_use", 0.0)),
+                "mem_frac_of_limit": float(
+                    last.get("mem_frac_of_limit", 0.0)),
+            }
+
+    def records(self) -> list[dict[str, Any]]:
+        """Ring snapshot, oldest first (the forensics bundle's last-K)."""
+        with self._lock:
+            return [dict(r) for r in self._ring]
+
+    def state(self) -> dict[str, Any]:
+        """JSON-ready snapshot for ``/status`` and ``/metrics``."""
+        with self._lock:
+            return {
+                "budget_frac": self.budget_frac,
+                "watermark_bytes": self.watermark_bytes,
+                "peak_bytes": self.peak_bytes,
+                "limit_bytes": self.limit_bytes,
+                "pressure_active": self._pressure_active,
+                "polls": self.polls,
+                "phase_peaks": dict(self.phase_peaks),
+                "devices": ([dict(r) for r in self._last_rows]
+                            if self._last_rows else None),
+                "static": self.static_model,
+                "ring_len": len(self._ring),
+            }
+
+    def forensics(self) -> dict[str, Any]:
+        """The memory forensics payload (``memory.json`` in a triage
+        bundle): live-buffer census + compile-time split + the last K
+        mem records + watermarks."""
+        return forensics_payload(self)
+
+
+def forensics_payload(monitor: MemoryMonitor | None = None
+                      ) -> dict[str, Any]:
+    """Build the ``memory.json`` bundle artifact. Works without a
+    monitor (an OOM crash on a run without ``--mem_report`` still gets
+    the census — the live arrays exist regardless)."""
+    payload: dict[str, Any] = {"census": live_buffer_census()}
+    if monitor is not None:
+        with monitor._lock:
+            payload.update({
+                "static_model": monitor.static_model,
+                "watermark_bytes": monitor.watermark_bytes,
+                "peak_bytes": monitor.peak_bytes,
+                "limit_bytes": monitor.limit_bytes,
+                "phase_peaks": dict(monitor.phase_peaks),
+                "records": [dict(r) for r in monitor._ring],
+            })
+    else:
+        payload.update({"static_model": None, "records": []})
+    return payload

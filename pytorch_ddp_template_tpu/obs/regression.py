@@ -47,6 +47,11 @@ DIRECTIONS = {
     "step_time_p90_ms": "higher_is_worse",
     "step_time_mean_ms": "higher_is_worse",
     "mfu": "lower_is_worse",
+    # r15: peak HBM (measured watermark when the backend reports one,
+    # else the static compile-time projection) — a restore whose memory
+    # footprint grew out of band is marching toward the OOM cliff even
+    # when its step walls look fine
+    "peak_hbm_bytes": "higher_is_worse",
 }
 
 #: config facts that change what a fair step-wall comparison means —
@@ -73,7 +78,8 @@ def make_fingerprint(*, timer_summary: dict[str, float],
                      frac_host: float | None = None,
                      steps: int | None = None,
                      attempt: int = 1,
-                     config_sig: dict[str, Any] | None = None
+                     config_sig: dict[str, Any] | None = None,
+                     peak_hbm_bytes: float | None = None
                      ) -> dict[str, Any]:
     """One attempt's steady-state perf fingerprint (JSON-ready)."""
     fp: dict[str, Any] = {
@@ -89,6 +95,8 @@ def make_fingerprint(*, timer_summary: dict[str, float],
         fp["mfu"] = float(mfu)
     if wire_bytes_total is not None:
         fp["wire_bytes_total"] = int(wire_bytes_total)
+    if peak_hbm_bytes is not None:
+        fp["peak_hbm_bytes"] = float(peak_hbm_bytes)
     if frac_host is not None:
         fp["frac_host"] = float(frac_host)
     if steps is not None:

@@ -217,11 +217,14 @@ class FlightRecorder:
              ring: list[dict[str, Any]],
              config: Any = None,
              describe_snapshot: dict[str, Any] | None = None,
-             fingerprint: list[float] | None = None) -> Path:
+             fingerprint: list[float] | None = None,
+             extra: dict[str, Any] | None = None) -> Path:
         """Write one complete bundle; returns its directory. Each file is
         written best-effort and independently — a failure in one artifact
         (e.g. a describe() that raises on poisoned params) must not cost
-        the others."""
+        the others. ``extra`` maps additional artifact filenames to
+        JSON-ready payloads (the r15 memory forensics rides here as
+        ``memory.json``); :data:`BUNDLE_FILES` stays the minimum set."""
         # atomic claim, not check-then-act: a fleet-replicated trigger
         # (the r14 straggler verdict, a replicated-NaN anomaly) dumps
         # from EVERY host at once, and on a shared output_dir a bare
@@ -267,5 +270,7 @@ class FlightRecorder:
                 "note": "per-leaf (sum, l2) digest of the replicated "
                         "params (utils/divergence.fingerprint); null when "
                         "the state was not safely readable at dump time"})
+        for name, payload in (extra or {}).items():
+            _write(name, payload)
         log.warning("flight record dumped", {"dir": str(d)})
         return d

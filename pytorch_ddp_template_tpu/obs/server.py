@@ -8,8 +8,9 @@ HTTP. ``--status_port N`` starts a background
 ``ThreadingHTTPServer`` on a daemon thread serving three routes:
 
 - ``GET /status`` — one JSON document: the latest drained telemetry
-  records by kind (progress/perf/eval), the goodput summary, sentry
-  state, the fleet table, and the startup ``describe.json`` snapshot.
+  records by kind (progress/perf/eval/mem), the goodput summary, sentry
+  state, the fleet table, the memory-monitor state (r15), and the
+  startup ``describe.json`` snapshot.
   All state is already host-side (drained) floats; request handling
   never touches a device and never blocks the train loop.
 - ``GET /metrics`` — the same numerics in Prometheus text exposition
@@ -118,7 +119,7 @@ def prometheus_lines(snapshot: dict[str, Any]) -> str:
     if age is not None:
         _gauge(lines, seen, prom_name("last_update_age_seconds"), age,
                {"host": host})
-    for kind in ("progress", "perf"):
+    for kind in ("progress", "perf", "mem"):
         rec = snapshot.get("records", {}).get(kind) or {}
         for k, v in rec.items():
             if isinstance(v, (list, tuple)) or k.endswith("_repr"):
@@ -148,6 +149,42 @@ def prometheus_lines(snapshot: dict[str, Any]) -> str:
         _gauge(lines, seen, prom_name("fleet_straggler"),
                0.0 if strag is None else 1.0,
                {"host": "" if strag is None else str(strag.get("host"))})
+    mem = snapshot.get("memory") or {}
+    if mem:
+        # the r15 HBM watchtower: per-device gauges (device-labelled)
+        # plus the host-level watermark/limit/pressure summary. Absent
+        # entries (CPU backends report no memory_stats) simply emit no
+        # sample — a scrape never shows an invented 0-byte HBM.
+        # per-device family under its OWN metric names: the latest mem
+        # RECORD also exports host-level mem_bytes_in_use/... gauges
+        # (the records loop above), and one metric name carrying both a
+        # host-level max and per-device samples would double-count in
+        # any PromQL sum over the family
+        for row in mem.get("devices") or []:
+            labels = {"host": host, "device": str(int(row.get("device", 0)))}
+            _gauge(lines, seen, prom_name("mem_device_bytes_in_use"),
+                   row.get("bytes_in_use"), labels,
+                   help_="HBM bytes in use per device (memory_stats)")
+            _gauge(lines, seen, prom_name("mem_device_peak_bytes"),
+                   row.get("peak_bytes_in_use"), labels)
+            _gauge(lines, seen, prom_name("mem_device_limit_bytes"),
+                   row.get("bytes_limit"), labels)
+        if mem.get("watermark_bytes"):
+            _gauge(lines, seen, prom_name("mem_watermark_bytes"),
+                   mem["watermark_bytes"], {"host": host},
+                   help_="high-watermark HBM bytes in use this attempt")
+        if mem.get("limit_bytes") and mem.get("watermark_bytes"):
+            _gauge(lines, seen, prom_name("mem_watermark_frac_of_limit"),
+                   float(mem["watermark_bytes"]) / float(mem["limit_bytes"]),
+                   {"host": host})
+        if "pressure_active" in mem:
+            _gauge(lines, seen, prom_name("mem_pressure_active"),
+                   1.0 if mem.get("pressure_active") else 0.0,
+                   {"host": host})
+        split = (mem.get("static") or {}).get("split") or {}
+        _gauge(lines, seen, prom_name("mem_projected_peak_bytes"),
+               split.get("projected_peak_bytes"), {"host": host},
+               help_="compile-time projected peak (memory_analysis)")
     return "\n".join(lines) + "\n"
 
 

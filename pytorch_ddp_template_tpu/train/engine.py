@@ -436,6 +436,20 @@ class Trainer:
         # perf attribution (--perf_report): built by _startup_reports
         # from the shared AOT compile; None = no attribution records
         self.perf = None
+        # memory X-ray (--mem_report, obs/memory.py): compile-time
+        # split + donation audit ride _startup_reports; the runtime
+        # watermark poller runs on the telemetry drain thread
+        # (kind="mem" records at the perf cadence); the capacity
+        # tripwire feeds the sentry as a mem_pressure trigger
+        self.memory = None
+        if config.mem_report:
+            from ..obs.memory import MemoryMonitor
+
+            self.memory = MemoryMonitor(
+                ctx.mesh.local_devices,
+                budget_frac=config.mem_budget_frac,
+                on_pressure=self._on_mem_pressure)
+            self.telemetry.on_mem = self.memory.observe
         # mid-run retrace detection (goodput `compile` bucket + the
         # shape-change warning): the jit cache grows exactly when a
         # dispatch traced+compiled a new executable
@@ -660,21 +674,24 @@ class Trainer:
                     self.status.sources["sentry"] = self.sentry.state
                 if self.fleet is not None:
                     self.status.sources["fleet"] = self.fleet.state
+                if self.memory is not None:
+                    self.status.sources["memory"] = self.memory.state
                 self.status.start()
             except Exception:  # noqa: BLE001
                 log.exception("--status_port server failed to start; "
                               "continuing without it")
                 self.status = None
 
-        if cfg.hlo_report or cfg.perf_report:
+        if cfg.hlo_report or cfg.perf_report or cfg.mem_report:
             # best-effort by design: a report/tripwire/attribution
             # failure must never cost the training run it exists to
-            # protect. ONE shared AOT compile feeds both consumers.
+            # protect. ONE shared AOT compile feeds all consumers.
             try:
                 self._startup_reports(state)
             except Exception:  # noqa: BLE001
-                log.exception("--hlo_report/--perf_report startup analysis "
-                              "failed; continuing without it")
+                log.exception("--hlo_report/--perf_report/--mem_report "
+                              "startup analysis failed; continuing "
+                              "without it")
 
         # graceful preemption (SLURM/TPU-VM maintenance send SIGTERM):
         # finish the in-flight step, checkpoint, exit cleanly — the next
@@ -1092,13 +1109,22 @@ class Trainer:
             # it (best-effort — state may be poisoned or donated mid-step)
             # before the exception propagates to train()'s finally
             if self.recorder is not None:
+                from ..obs.memory import looks_like_oom
+
+                oom = looks_like_oom(exc)
                 try:
                     self._dump_flight_record(state, {
                         "step": global_step,
                         "reasons": [f"exception: {exc!r}"],
                         "mode": "crash",
+                        "oom": oom,
                         "time": time.time(),
-                    }, fingerprint_ok=False)
+                    }, fingerprint_ok=False,
+                        # an allocation failure gets the memory
+                        # forensics (live-buffer census + compile split
+                        # + last K mem records) even without
+                        # --mem_report — the live arrays exist anyway
+                        mem_forensics=True if oom else None)
                 except Exception:  # noqa: BLE001
                     log.exception("crash flight-record dump failed")
             raise
@@ -1180,6 +1206,12 @@ class Trainer:
             self._emit_fleet_window(global_step, wall_s=wall_s,
                                     steps=steps, input_s=input_s,
                                     device_s=device_s, idle_s=idle_s)
+        if self.memory is not None:
+            # HBM watermark sample: a cadence marker only — the
+            # device.memory_stats() poll happens on the DRAIN thread
+            # (obs/memory.MemoryMonitor.observe), and the resolved
+            # record writes as kind="mem"
+            self.telemetry.emit(global_step, {}, kind="mem")
         # perf-regression tripwire: one comparison per attempt, once
         # the steady-state timer has enough honest samples
         self._maybe_check_baseline()
@@ -1258,6 +1290,11 @@ class Trainer:
             "anomaly": 1.0 if (self.sentry is not None
                                and self.sentry.triggered) else 0.0,
         }
+        if self.memory is not None:
+            # the r15 memory columns (zero-filled by encode_window when
+            # absent — this just supplies real values when they exist):
+            # a host leaking memory is a straggler-to-be
+            window.update(self.memory.wire_signals())
         self.telemetry.emit(global_step, window, kind="fleet")
 
     def _on_straggler(self, step: int, verdict: dict) -> None:
@@ -1298,6 +1335,11 @@ class Trainer:
             attempt=self.goodput.attempt,
             config_sig=config_signature(
                 self.config, n_devices=int(self.ctx.mesh.devices.size)),
+            # r15: peak HBM (measured watermark, else the static
+            # projection, else absent) — restores catch memory
+            # regressions the same way they catch step-wall ones
+            peak_hbm_bytes=(self.memory.peak_hbm_bytes()
+                            if self.memory is not None else None),
         )
 
     def _maybe_check_baseline(self) -> None:
@@ -1403,11 +1445,16 @@ class Trainer:
             self._halt_at_step = global_step + FLIGHT_TRACE_STEPS + 1
 
     def _dump_flight_record(self, state, trigger, *,
-                            fingerprint_ok: bool = True):
+                            fingerprint_ok: bool = True,
+                            mem_forensics: bool | None = None):
         """Write the triage bundle for ``trigger``; returns its directory
         (None when no recorder is configured). ``fingerprint_ok=False``
         skips the device fetch — crash dumps must not touch possibly
-        donated/poisoned buffers."""
+        donated/poisoned buffers. ``mem_forensics`` None = attach the
+        memory forensics (census + compile split + mem-record ring)
+        exactly when a ``--mem_report`` monitor exists; True forces a
+        census-only payload (the OOM crash path on runs without the
+        flag)."""
         if self.recorder is None:
             return None
         from ..parallel.sharding import describe
@@ -1430,9 +1477,20 @@ class Trainer:
             except Exception:  # noqa: BLE001
                 log.exception("fingerprint failed for flight record")
         ring = self.sentry.records() if self.sentry is not None else []
+        extra = None
+        if mem_forensics or (mem_forensics is None
+                             and self.memory is not None):
+            from ..obs.memory import forensics_payload
+
+            try:
+                extra = {"memory.json": forensics_payload(self.memory)}
+            except Exception:  # noqa: BLE001 - forensics must not cost
+                #               the rest of the bundle
+                log.exception("memory forensics failed for flight record")
         return self.recorder.dump(
             step=int(trigger.get("step", 0)), trigger=trigger, ring=ring,
-            config=self.config, describe_snapshot=desc, fingerprint=fp)
+            config=self.config, describe_snapshot=desc, fingerprint=fp,
+            extra=extra)
 
     def _startup_reports(self, state):
         """``--hlo_report`` / ``--perf_report``: ONE ahead-of-time
@@ -1446,7 +1504,8 @@ class Trainer:
         if self._with_stop:
             args.append(make_stop_flags(self.ctx.mesh, False))
         t0 = time.perf_counter()
-        compiled = self.train_step.lower(*args).compile()
+        lowered = self.train_step.lower(*args)
+        compiled = lowered.compile()
         compile_s = time.perf_counter() - t0
         # pre-loop compile wall is exactly what the goodput `compile`
         # bucket exists to expose
@@ -1459,6 +1518,12 @@ class Trainer:
                 #               cost the run (nor the hlo report below)
                 log.exception("--perf_report cost model failed; "
                               "continuing without attribution")
+        if self.config.mem_report:
+            try:
+                self._init_memory_report(compiled, lowered)
+            except Exception:  # noqa: BLE001 - same isolation contract
+                log.exception("--mem_report compile-time analysis "
+                              "failed; continuing without it")
         if self.config.hlo_report:
             self._emit_hlo_report(hlo_text, compile_s)
 
@@ -1479,6 +1544,62 @@ class Trainer:
             peak_tflops_override=self.config.peak_tflops,
         )
         log.info("perf attribution cost model", self.perf.describe())
+
+    def _init_memory_report(self, compiled, lowered) -> None:
+        """``--mem_report``'s compile-time half (obs/memory.py): the
+        memory_analysis split + the donation audit off the shared
+        startup executable, handed to the runtime monitor; donation
+        gaps and a projected peak above the capacity budget WARN here,
+        at startup — before the run walks into the cliff."""
+        from ..obs.memory import (
+            donation_warnings, static_memory_model,
+        )
+
+        args_info = getattr(lowered, "args_info", None)
+        model = static_memory_model(compiled, args_info)
+        self.memory.set_static_model(model)
+        split = model.get("split") or {}
+        audit = model.get("donation") or {}
+        log.info("memory X-ray compile-time report", {
+            "argument_mb": round(split.get("argument_bytes", 0) / 1e6, 2),
+            "output_mb": round(split.get("output_bytes", 0) / 1e6, 2),
+            "temp_mb": round(split.get("temp_bytes", 0) / 1e6, 2),
+            "generated_code_mb": round(
+                split.get("generated_code_bytes", 0) / 1e6, 2),
+            "alias_mb": round(split.get("alias_bytes", 0) / 1e6, 2),
+            "projected_peak_mb": round(
+                split.get("projected_peak_bytes", 0) / 1e6, 2),
+            "donated_leaves": audit.get("donated_leaves"),
+            "undonated_leaves": audit.get("undonated_leaves"),
+            "analysis_available": model.get("available"),
+        } if split else {"analysis_available": False,
+                         "donated_leaves": audit.get("donated_leaves"),
+                         "undonated_leaves": audit.get("undonated_leaves")})
+        for w in donation_warnings(model):
+            log.warning(w)
+        for w in self.memory.startup_warnings():
+            log.warning(w)
+
+    def _on_mem_pressure(self, step: int, verdict: dict) -> None:
+        """Memory-pressure verdict (drain thread): feed the sentry as a
+        ``mem_pressure`` trigger so the standard triage bundle lands
+        with the numbers — and the memory forensics attached — or, with
+        no sentry configured, at least say it loudly."""
+        reasons = [
+            f"HBM watermark {verdict['bytes_in_use'] / 1e9:.2f} GB is "
+            f"{100 * verdict['frac_of_limit']:.1f}% of the "
+            f"{verdict['bytes_limit'] / 1e9:.2f} GB device limit "
+            f"(budget --mem_budget_frac="
+            f"{verdict['budget_frac']:g}) on device "
+            f"{verdict['device']} during phase {verdict['phase']!r}"]
+        if self.sentry is not None:
+            self.sentry.external_trigger(step, reasons,
+                                         kind="mem_pressure",
+                                         scalars=verdict)
+        else:
+            log.warning(
+                "memory pressure detected (no --anomaly sentry active, "
+                "so no triage bundle): " + reasons[0], verdict)
 
     def _emit_hlo_report(self, hlo_text: str, compile_s: float):
         """Write the schedule report + tripwire warnings

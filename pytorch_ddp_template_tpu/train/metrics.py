@@ -161,6 +161,15 @@ OnHealth = Callable[[int, dict[str, Any]], None]
 #: (the progress record already carries this host's raw signals).
 OnFleet = Callable[[int, dict[str, Any]], None]
 
+#: mem-record resolver: (step, scalars) -> flat record | None — the r15
+#: memory watchtower's ``observe``. ``kind="mem"`` records route here
+#: FIRST: the loop emits an empty marker at the perf cadence and the
+#: drain thread does the ``device.memory_stats()`` poll (host-side PJRT
+#: bookkeeping, still not the hot loop's business). Unlike health/fleet
+#: the RESOLVED record then goes to the writer — the HBM watermark is a
+#: durable low-cadence channel like ``perf``, not a per-step feed.
+OnMem = Callable[[int, dict[str, Any]], "dict[str, Any] | None"]
+
 
 class SyncTelemetry:
     """Inline sink: convert-and-write at emit time, blocking on the
@@ -175,6 +184,7 @@ class SyncTelemetry:
         self.on_write: OnWrite | None = None
         self.on_health: OnHealth | None = None
         self.on_fleet: OnFleet | None = None
+        self.on_mem: OnMem | None = None
 
     def emit(self, step: int, scalars: dict[str, Any],
              kind: str = "progress") -> None:
@@ -191,6 +201,15 @@ class SyncTelemetry:
             if self.on_fleet is not None:
                 self.on_fleet(step, _to_host(scalars))
             return
+        if kind == "mem":
+            # inline poll, same sync-mode contract; the resolved record
+            # (when the monitor produced one) writes like any other
+            if self.on_mem is None:
+                return
+            rec = self.on_mem(step, dict(scalars))
+            if not rec:
+                return
+            scalars = rec
         host = _to_host(scalars)
         self.latest = host
         self.writer.write(step, host)
@@ -222,6 +241,7 @@ class AsyncTelemetry:
         self.on_write: OnWrite | None = None
         self.on_health: OnHealth | None = None
         self.on_fleet: OnFleet | None = None
+        self.on_mem: OnMem | None = None
         # bounded: if the writer ever falls an entire queue behind, emit
         # blocks rather than growing host buffers without limit
         self._q: queue.Queue = queue.Queue(maxsize=maxsize)
@@ -267,6 +287,21 @@ class AsyncTelemetry:
             except Exception:  # noqa: BLE001 - fleet must not kill drain
                 log.exception("fleet record dropped")
             return
+        if kind == "mem":
+            # the r15 HBM watermark: the device.memory_stats() poll runs
+            # on this (drain) thread — the loop only emitted a cadence
+            # marker. The monitor's resolved record (watermark, per-
+            # device rows, frac-of-limit) then writes like a perf record
+            if self.on_mem is None:
+                return
+            try:
+                rec = self.on_mem(step, dict(scalars))
+            except Exception:  # noqa: BLE001 - mem must not kill drain
+                log.exception("mem record dropped")
+                return
+            if not rec:
+                return
+            scalars = rec
         if not self.writer.active and self.on_write is None:
             return  # non-main process: nothing consumes the conversion
         try:

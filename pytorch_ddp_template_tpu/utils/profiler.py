@@ -42,6 +42,25 @@ _annotations_enabled = True
 
 _NULL = contextlib.nullcontext()
 
+#: the loop thread's live phase-name stack (r15): :func:`annotate` spans
+#: push/pop their name so the memory watermark poller (telemetry drain
+#: thread) can attribute a sample to the phase active when it fired.
+#: Written by the loop thread only; the cross-thread read is a racy
+#: last-element peek by design — a one-sample-stale phase label is
+#: honest enough for peak attribution, and a lock here would tax every
+#: loop phase to serve a per-cadence poll.
+_phase_stack: list[str] = []
+
+
+def current_phase() -> str:
+    """The innermost active :func:`annotate` phase name on the loop
+    thread (``"between_steps"`` outside any span or with annotations
+    disabled) — the r13 named phases, readable without a trace."""
+    try:
+        return _phase_stack[-1]
+    except IndexError:
+        return "between_steps"
+
 
 def set_phase_annotations(enabled: bool) -> None:
     """Globally enable/disable :func:`annotate` (process-wide). Default
@@ -54,12 +73,34 @@ def phase_annotations_enabled() -> bool:
     return _annotations_enabled
 
 
+class _PhaseAnnotation(jax.profiler.TraceAnnotation):
+    """A TraceAnnotation that also tracks the phase name for
+    :func:`current_phase` (subclass so callers pinning the
+    TraceAnnotation contract keep holding one)."""
+
+    def __init__(self, name: str):
+        super().__init__(name)
+        self._phase_name = name
+
+    def __enter__(self):
+        _phase_stack.append(self._phase_name)
+        return super().__enter__()
+
+    def __exit__(self, *exc):
+        try:
+            return super().__exit__(*exc)
+        finally:
+            if _phase_stack and _phase_stack[-1] == self._phase_name:
+                _phase_stack.pop()
+
+
 def annotate(name: str):
     """Context manager naming the enclosed host span ``name`` in any
-    active profiler trace (no-op context when disabled)."""
+    active profiler trace (no-op context when disabled) and exposing it
+    via :func:`current_phase` while active."""
     if not _annotations_enabled:
         return _NULL
-    return jax.profiler.TraceAnnotation(name)
+    return _PhaseAnnotation(name)
 
 
 class TraceWindow:

@@ -543,6 +543,93 @@ def test_fleet_record_committed_and_affirmative():
     assert last["bench_diff_slowed_rc"] != 0
 
 
+@pytest.mark.slow
+def test_mem_mode_contract():
+    """BENCH_MODE=mem: one JSON line carrying the round-15 memory-X-ray
+    legs — the mem_report neutrality pair over the full production loop,
+    the remat A/B sign-consistency check against raw memory_analysis,
+    the faked-pressure bundle with /metrics HBM gauges scraped live, and
+    the injected-OOM forensics bundle (slow: eight full Trainer runs +
+    two AOT compiles in a subprocess; the committed record in
+    bench_records/mem_cpu_r15.jsonl is the tier-1-visible evidence)."""
+    code, lines, out = run_bench({
+        "BENCH_MODE": "mem", "BENCH_MODEL": "gpt-tiny",
+        "BENCH_BATCH": "8", "BENCH_WARMUP": "1", "BENCH_STEPS": "6",
+        "BENCH_LOG_STEPS": "2", "BENCH_OOM_STEP": "4",
+        "BENCH_OUTPUT": "/tmp/bench_mem_contract",
+    }, timeout=600)
+    assert code == 0, out[-2000:]
+    assert len(lines) == 1, out[-2000:]
+    row = lines[0]
+    assert REQUIRED <= set(row)
+    assert row["metric"] == "mem_overhead_ratio"
+    assert row["value"] > 0
+    assert row["mem_records_written"] > 0
+    # CPU: the static-degradation path is what this host pins
+    assert row["mem_measured"] == 0.0
+    assert row["static_split_temp_bytes"] > 0
+    # remat shrinks temps, and the production split agrees with the raw
+    # analysis in sign
+    assert row["remat_temp_delta_bytes"] < 0
+    assert row["remat_delta_sign_consistent"] is True
+    # faked pressure rode the sentry into a bundle with forensics, and
+    # /metrics exposed the per-device HBM gauges mid-run
+    assert row["pressure_bundle_complete"] is True
+    assert row["pressure_trigger_kind"] == "mem_pressure"
+    assert row["metrics_http_mem_gauges"] is True
+    # the injected OOM left complete forensics through the crash path
+    assert row["oom_raised"] is True
+    assert row["oom_forensics_complete"] is True
+
+
+def test_mem_record_committed_and_affirmative():
+    """The committed round-15 CPU record must exist and actually show
+    the evidence the round claims: mem_report inside the 0.9 step-time
+    band, kind="mem" records written (static-degradation on this CPU
+    host, labelled as such), the remat A/B temp-bytes delta negative and
+    sign-consistent with memory_analysis, the mem_pressure bundle
+    complete, live HBM gauges, and the injected-OOM forensics bundle
+    complete (census + compile-time split) through the production
+    flight-recorder path."""
+    import json
+    from pathlib import Path
+
+    path = Path(__file__).resolve().parent.parent / "bench_records" / \
+        "mem_cpu_r15.jsonl"
+    assert path.is_file(), "run BENCH_MODE=mem to record the legs"
+    records = [json.loads(l) for l in path.read_text().splitlines() if l]
+    assert records
+    last = records[-1]
+    assert last["metric"] == "mem_overhead_ratio"
+    assert last["value"] >= 0.9  # neutrality band: the X-ray is ~free
+    assert last["vs_baseline"] >= 1.0
+    assert last["mem_records_written"] > 0
+    assert last["mem_measured"] == 0.0  # CPU: static model, labelled
+    assert last["static_split_temp_bytes"] > 0
+    assert last["static_split_projected_peak_bytes"] > 0
+    assert last["remat_temp_delta_bytes"] < 0  # remat shrinks temps
+    assert last["remat_delta_sign_consistent"] is True
+    assert last["pressure_bundle_complete"] is True
+    assert last["pressure_trigger_kind"] == "mem_pressure"
+    assert last["pressure_frac_of_limit"] > 0.9
+    assert last["metrics_http_mem_gauges"] is True
+    assert last["oom_raised"] is True
+    assert last["oom_trigger_mode"] == "crash"
+    assert last["oom_trigger_flagged"] is True
+    assert last["oom_census_arrays"] > 0
+    assert last["oom_forensics_complete"] is True
+
+
+def test_bench_diff_ablation_keys_match_ci_gate():
+    """r15 satellite: tools/ci_bench_check.sh is a thin wrapper over
+    tools/bench_diff.py — the self-check over the committed records must
+    exit 0 (the tripwire is armed and every committed record parses)."""
+    p = subprocess.run(["bash", "tools/ci_bench_check.sh"], cwd=REPO,
+                       capture_output=True, text=True, timeout=120)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "### bench_diff" in p.stdout  # the github-format table
+
+
 def test_comms_record_committed_and_affirmative():
     """The committed round-9 CPU record must exist and actually show the
     evidence the round claims: >= depth independent in-scan reduces, int8

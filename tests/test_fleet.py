@@ -87,6 +87,48 @@ class TestWireCodec:
         assert rows[1]["step"] == 1.0
         assert rows[1][FLEET_WIRE_KEYS[-1]] == 0.0
 
+    def test_r15_mem_keys_appended_at_the_end(self):
+        """The version seam, pinned (r15 satellite): the memory columns
+        were APPENDED to FLEET_WIRE_KEYS — prefix order is frozen, so an
+        old peer's rows still align."""
+        assert FLEET_WIRE_KEYS[:10] == (
+            "step", "step_wall_ms", "frac_input", "frac_device",
+            "frac_host", "input_wait_ms", "producer_idle_ms",
+            "gp_productive_s", "gp_wall_s", "anomaly")
+        assert FLEET_WIRE_KEYS[10:] == ("mem_bytes_in_use",
+                                        "mem_frac_of_limit")
+
+    def test_old_width_row_zero_fills_new_mem_keys(self):
+        """The documented zero-fill/extra-column tolerance, exercised
+        against a REAL old-width row (the r14 wire was 10 columns — a
+        mixed-version fleet mid-rolling-upgrade ships exactly this), not
+        just trusted from the comment."""
+        OLD_WIDTH = 10  # the r14 vector: everything before the mem keys
+        old_row = np.arange(1, OLD_WIDTH + 1, dtype=np.float32)
+        new_row = encode_window(window(step=2, wall=7.0,
+                                       mem_bytes_in_use=5e8,
+                                       mem_frac_of_limit=0.5))
+        assert new_row.shape[0] == OLD_WIDTH + 2
+        # old peer's row next to this version's: pad like _default_exchange
+        padded = np.zeros_like(new_row)
+        padded[:OLD_WIDTH] = old_row
+        rows = decode_rows(np.stack([padded, new_row]))
+        # the old peer's r14 columns land intact...
+        assert rows[0]["step"] == 1.0
+        assert rows[0]["step_wall_ms"] == 2.0
+        assert rows[0]["anomaly"] == 10.0
+        # ...its missing mem columns read zero (degrade, not misalign)...
+        assert rows[0]["mem_bytes_in_use"] == 0.0
+        assert rows[0]["mem_frac_of_limit"] == 0.0
+        # ...and this version's row keeps its mem data
+        assert rows[1]["mem_bytes_in_use"] == 5e8
+        assert rows[1]["mem_frac_of_limit"] == 0.5
+        # extra columns from a NEWER peer are ignored (the other side
+        # of the same seam)
+        wider = np.concatenate([new_row, [42.0, 43.0]]).astype(np.float32)
+        rec = decode_rows(wider[None, :])[0]
+        assert set(rec) == {"host", *FLEET_WIRE_KEYS}
+
 
 # -- aggregation -----------------------------------------------------------
 

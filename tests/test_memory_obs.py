@@ -1,0 +1,683 @@
+"""Round-15 memory X-ray: obs/memory.py (compile-time split + donation
+audit, live-buffer census, the runtime watermark monitor with its
+capacity tripwire), the phase tracking behind per-phase peak attribution,
+the engine wiring — mem records through the production telemetry drain,
+the mem_pressure trigger → sentry bundle path with ``memory.json``
+forensics, the injected-OOM crash bundle, /metrics HBM gauges, and the
+peak-HBM stamp in perf_baseline.json."""
+
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_ddp_template_tpu.obs.memory import (
+    MemoryMonitor,
+    compile_memory_split,
+    device_memory_rows,
+    donation_audit,
+    donation_warnings,
+    forensics_payload,
+    live_buffer_census,
+    looks_like_oom,
+    static_memory_model,
+)
+
+
+@pytest.fixture(scope="module")
+def donated_lowered():
+    f = jax.jit(lambda s, b: {k: v + b for k, v in s.items()},
+                donate_argnums=(0,))
+    return f.lower({"a": jnp.ones((64,)), "b": jnp.ones((16,))},
+                   jnp.ones(()))
+
+
+@pytest.fixture(scope="module")
+def undonated_lowered():
+    f = jax.jit(lambda s, b: {k: v + b for k, v in s.items()})
+    return f.lower({"a": jnp.ones((64,)), "b": jnp.ones((16,))},
+                   jnp.ones(()))
+
+
+# -- compile-time split ------------------------------------------------------
+
+class TestCompileMemorySplit:
+    def test_split_fields_and_projection(self, donated_lowered):
+        split = compile_memory_split(donated_lowered.compile())
+        assert split is not None
+        # 64 + 16 floats in, same out (>=: XLA may add tuple/padding
+        # overhead — the split reports XLA's numbers, not ours)
+        assert split["argument_bytes"] >= 4 * (64 + 16 + 1)
+        assert split["output_bytes"] >= 4 * (64 + 16)
+        # donated state aliases: outputs reuse the argument buffers
+        assert split["alias_bytes"] == 4 * (64 + 16)
+        assert split["projected_peak_bytes"] == (
+            split["argument_bytes"] + split["output_bytes"]
+            - split["alias_bytes"] + split["temp_bytes"]
+            + split["generated_code_bytes"])
+
+    def test_broken_backend_yields_none_not_zeros(self):
+        class Broken:
+            def memory_analysis(self):
+                raise RuntimeError("unimplemented on this PJRT backend")
+
+        class Absent:
+            def memory_analysis(self):
+                return None
+
+        assert compile_memory_split(Broken()) is None
+        assert compile_memory_split(Absent()) is None
+
+    def test_partial_analysis_is_no_analysis(self):
+        class Partial:
+            def memory_analysis(self):
+                class Stats:  # argument bytes only — not a usable split
+                    argument_size_in_bytes = 123
+                return Stats()
+
+        assert compile_memory_split(Partial()) is None
+
+
+# -- donation audit ----------------------------------------------------------
+
+class TestDonationAudit:
+    def test_donated_state_is_clean(self, donated_lowered):
+        audit = donation_audit(donated_lowered.args_info)
+        assert audit["available"]
+        assert audit["donated_leaves"] == 2
+        assert audit["undonated_leaves"] == 0
+        assert audit["donated_bytes"] == 4 * (64 + 16)
+        model = static_memory_model(donated_lowered.compile(),
+                                    donated_lowered.args_info)
+        assert model["donation_honoured"] is True
+        assert donation_warnings(model) == []
+
+    def test_undonated_state_is_named(self, undonated_lowered):
+        audit = donation_audit(undonated_lowered.args_info)
+        assert audit["undonated_leaves"] == 2
+        assert audit["undonated_bytes"] == 4 * (64 + 16)
+        assert len(audit["undonated_paths"]) == 2
+        assert any("a" in p for p in audit["undonated_paths"])
+        model = static_memory_model(undonated_lowered.compile(),
+                                    undonated_lowered.args_info)
+        warns = donation_warnings(model)
+        assert warns and "NOT donated" in warns[0]
+        assert "doubled state footprint" in warns[0]
+
+    def test_unhonoured_donation_warns(self, donated_lowered):
+        # donation requested, but XLA aliased (nearly) nothing: the
+        # cross-check must flag it even though every leaf says donated
+        model = static_memory_model(donated_lowered.compile(),
+                                    donated_lowered.args_info)
+        model["split"] = dict(model["split"], alias_bytes=0)
+        model["donation_honoured"] = False
+        warns = donation_warnings(model)
+        assert warns and "unhonoured donation" in warns[0]
+
+    def test_missing_args_info_is_unavailable_not_invented(self):
+        audit = donation_audit(None)
+        assert audit == {"available": False}
+        model = static_memory_model(object(), None)
+        assert model["available"] is False  # broken compiled too
+        assert model["donation"] == {"available": False}
+        assert "donation_honoured" not in model
+        assert donation_warnings(model) == []
+
+
+# -- live-buffer census ------------------------------------------------------
+
+class TestLiveBufferCensus:
+    def test_buckets_by_shape_dtype_sharding(self):
+        keep = [jnp.ones((128, 4), jnp.float32) for _ in range(3)]
+        keep.append(jnp.ones((7,), jnp.int32))
+        census = live_buffer_census()
+        assert census["available"]
+        assert census["n_arrays"] >= 4
+        big = next(b for b in census["buckets"]
+                   if b["shape"] == "(128, 4)" and b["dtype"] == "float32")
+        assert big["count"] >= 3
+        assert big["bytes"] >= 3 * 128 * 4 * 4
+        assert census["total_bytes"] >= sum(
+            b["bytes"] for b in census["buckets"])
+        del keep
+
+    def test_sorted_and_bounded(self):
+        arrays = [np.ones((n + 1,), np.float32) for n in range(10)]
+        # numpy arrays quack enough (shape/dtype/nbytes, no sharding)
+        census = live_buffer_census(arrays=arrays, top=4)
+        sizes = [b["bytes"] for b in census["buckets"]]
+        assert sizes == sorted(sizes, reverse=True)
+        assert len(census["buckets"]) == 4
+        assert census["truncated"]["buckets"] == 6
+        # nothing silently dropped: head + tail == total
+        assert (sum(sizes) + census["truncated"]["bytes"]
+                == census["total_bytes"])
+
+    def test_empty_is_fine(self):
+        census = live_buffer_census(arrays=[])
+        assert census["n_arrays"] == 0
+        assert census["buckets"] == []
+        assert census["truncated"] is None
+
+
+# -- runtime rows + degradation ---------------------------------------------
+
+class TestDeviceMemoryRows:
+    def test_cpu_backend_degrades_to_none(self):
+        # this jaxlib's CPU devices report no memory_stats: the poller
+        # must say "unmeasurable", never a 0-byte watermark
+        assert device_memory_rows(jax.devices()) is None
+
+    def test_rows_shape_with_a_reporting_device(self):
+        class FakeDev:
+            device_kind = "fake-hbm"
+
+            def memory_stats(self):
+                return {"bytes_in_use": 100, "peak_bytes_in_use": 150,
+                        "bytes_limit": 1000}
+
+        class DeadDev:
+            device_kind = "dead"
+
+            def memory_stats(self):
+                raise RuntimeError("no stats")
+
+        rows = device_memory_rows([FakeDev(), DeadDev()])
+        assert rows == [{"device": 0, "kind": "fake-hbm",
+                         "bytes_in_use": 100, "peak_bytes_in_use": 150,
+                         "bytes_limit": 1000}]
+
+
+def fake_poll_seq(fracs, limit=1000):
+    """A poll returning one device whose usage walks through ``fracs``
+    of ``limit`` (repeating the last one)."""
+    it = {"i": 0}
+
+    def poll():
+        f = fracs[min(it["i"], len(fracs) - 1)]
+        it["i"] += 1
+        return [{"device": 0, "kind": "fake", "bytes_in_use": int(limit * f),
+                 "peak_bytes_in_use": int(limit * f), "bytes_limit": limit}]
+
+    return poll
+
+
+class TestMemoryMonitor:
+    def test_watermark_and_record_fields(self):
+        mon = MemoryMonitor(poll=fake_poll_seq([0.5, 0.7, 0.6]))
+        recs = [mon.observe(s) for s in (1, 2, 3)]
+        assert recs[0]["mem_measured"] == 1.0
+        assert recs[0]["mem_bytes_in_use"] == 500.0
+        assert recs[0]["mem_frac_of_limit"] == 0.5
+        assert recs[2]["mem_watermark_bytes"] == 700.0  # high watermark
+        assert mon.peak_hbm_bytes() == 700.0
+        assert list(recs[0]["mem_bytes_in_use_per_device"]) == [500.0]
+        assert mon.state()["limit_bytes"] == 1000.0
+        assert len(mon.records()) == 3
+
+    def test_tripwire_once_per_episode_and_rearm(self):
+        fired = []
+        mon = MemoryMonitor(
+            budget_frac=0.9,
+            on_pressure=lambda step, v: fired.append((step, v)),
+            poll=fake_poll_seq([0.5, 0.95, 0.97, 0.5, 0.93]))
+        for s in range(5):
+            mon.observe(s)
+        # one verdict for the 0.95/0.97 episode, one for the 0.93 one
+        assert [s for s, _ in fired] == [1, 4]
+        step, verdict = fired[0]
+        assert verdict["frac_of_limit"] == 0.95
+        assert verdict["budget_frac"] == 0.9
+        assert verdict["bytes_limit"] == 1000
+
+    def test_no_limit_no_tripwire(self):
+        fired = []
+        mon = MemoryMonitor(
+            on_pressure=lambda s, v: fired.append(v),
+            poll=lambda: [{"device": 0, "kind": "x", "bytes_in_use": 999,
+                           "peak_bytes_in_use": 999, "bytes_limit": 0}])
+        rec = mon.observe(1)
+        assert fired == []
+        assert "mem_frac_of_limit" not in rec  # unknown limit: no ratio
+
+    def test_static_degradation_is_labelled(self):
+        mon = MemoryMonitor(poll=lambda: None)
+        assert mon.observe(1) is None  # no stats AND no model: nothing
+        mon.set_static_model({"available": True, "split": {
+            "argument_bytes": 10, "output_bytes": 5, "temp_bytes": 20,
+            "generated_code_bytes": 1, "alias_bytes": 5,
+            "projected_peak_bytes": 31}})
+        rec = mon.observe(2)
+        assert rec["mem_measured"] == 0.0
+        assert rec["mem_projected_peak_bytes"] == 31.0
+        assert "mem_bytes_in_use" not in rec  # a projection, not a reading
+        assert mon.peak_hbm_bytes() == 31.0  # fingerprint falls back
+
+    def test_never_raises(self):
+        def broken():
+            raise RuntimeError("poll exploded")
+
+        mon = MemoryMonitor(poll=broken)
+        assert mon.observe(1) is None
+
+    def test_budget_frac_validated(self):
+        with pytest.raises(ValueError, match="budget_frac"):
+            MemoryMonitor(budget_frac=0.0)
+        with pytest.raises(ValueError, match="budget_frac"):
+            MemoryMonitor(budget_frac=1.5)
+
+    def test_startup_warning_over_budget(self):
+        mon = MemoryMonitor(budget_frac=0.9,
+                            poll=fake_poll_seq([0.1], limit=1000))
+        mon.set_static_model({"available": True, "split": {
+            "argument_bytes": 100, "output_bytes": 50, "temp_bytes": 900,
+            "generated_code_bytes": 0, "alias_bytes": 50,
+            "projected_peak_bytes": 1000}})
+        warns = mon.startup_warnings()
+        assert warns and "memory budget tripwire" in warns[0]
+        assert "--mem_budget_frac" in warns[0]
+
+    def test_startup_silent_without_limit_or_in_budget(self):
+        # CPU: no limit → unmeasurable, not a pass or a fail
+        mon = MemoryMonitor(poll=lambda: None)
+        mon.set_static_model({"available": True, "split": {
+            "argument_bytes": 100, "output_bytes": 50, "temp_bytes": 900,
+            "generated_code_bytes": 0, "alias_bytes": 50,
+            "projected_peak_bytes": 1000}})
+        assert mon.startup_warnings() == []
+        # in budget: silent
+        mon2 = MemoryMonitor(budget_frac=0.9,
+                             poll=fake_poll_seq([0.1], limit=10_000))
+        mon2.set_static_model({"available": True, "split": {
+            "argument_bytes": 100, "output_bytes": 50, "temp_bytes": 900,
+            "generated_code_bytes": 0, "alias_bytes": 50,
+            "projected_peak_bytes": 1000}})
+        assert mon2.startup_warnings() == []
+
+    def test_phase_attribution_samples_named_phases(self):
+        from pytorch_ddp_template_tpu.utils.profiler import annotate
+
+        mon = MemoryMonitor(poll=fake_poll_seq([0.2, 0.8]))
+        with annotate("eval"):
+            mon.observe(1)
+        mon.observe(2)  # outside any span
+        peaks = mon.state()["phase_peaks"]
+        assert peaks["eval"] == 200.0
+        assert peaks["between_steps"] == 800.0
+
+    def test_wire_signals_zero_fill_when_unmeasured(self):
+        mon = MemoryMonitor(poll=lambda: None)
+        assert mon.wire_signals() == {"mem_bytes_in_use": 0.0,
+                                      "mem_frac_of_limit": 0.0}
+        mon2 = MemoryMonitor(poll=fake_poll_seq([0.5]))
+        mon2.observe(1)
+        assert mon2.wire_signals() == {"mem_bytes_in_use": 500.0,
+                                       "mem_frac_of_limit": 0.5}
+
+
+# -- forensics ---------------------------------------------------------------
+
+class TestForensics:
+    def test_payload_with_monitor(self):
+        mon = MemoryMonitor(poll=fake_poll_seq([0.5]))
+        mon.set_static_model({"available": True, "split": {"temp_bytes": 7}})
+        mon.observe(3)
+        p = forensics_payload(mon)
+        assert p["census"]["available"]
+        assert p["static_model"]["split"]["temp_bytes"] == 7
+        assert p["records"][-1]["step"] == 3
+        assert p["watermark_bytes"] == 500.0
+
+    def test_payload_without_monitor(self):
+        # an OOM crash on a run without --mem_report still gets a census
+        p = forensics_payload(None)
+        assert p["census"]["available"]
+        assert p["static_model"] is None
+        assert p["records"] == []
+
+    def test_looks_like_oom(self):
+        assert looks_like_oom(MemoryError())
+        assert looks_like_oom(RuntimeError(
+            "RESOURCE_EXHAUSTED: Out of memory allocating 1234 bytes"))
+        assert looks_like_oom(RuntimeError("Failed to allocate 8GB"))
+        assert looks_like_oom(RuntimeError("device OOM at step 12"))
+        assert not looks_like_oom(ValueError("shapes do not match"))
+        # the bare acronym matches on word boundaries only: mentioning
+        # BLOOM/ZOOM must not route a crash into memory triage
+        assert not looks_like_oom(RuntimeError(
+            "checkpoint for BLOOM-560m not found"))
+
+        # an exception whose __str__ raises must not raise OUT of the
+        # classifier — it runs in the engine's crash handler before the
+        # best-effort dump guard, and a secondary raise there would
+        # mask the real crash and lose the flight record entirely
+        class BrokenStr(RuntimeError):
+            def __str__(self):
+                raise ValueError("broken __str__")
+
+        assert looks_like_oom(BrokenStr()) is False
+
+
+# -- phase tracking ----------------------------------------------------------
+
+class TestCurrentPhase:
+    def test_stack_push_pop_and_nesting(self):
+        from pytorch_ddp_template_tpu.utils.profiler import (
+            annotate, current_phase,
+        )
+
+        assert current_phase() == "between_steps"
+        with annotate("input_wait"):
+            assert current_phase() == "input_wait"
+            with annotate("device_wait"):
+                assert current_phase() == "device_wait"
+            assert current_phase() == "input_wait"
+        assert current_phase() == "between_steps"
+
+    def test_disabled_annotations_report_between_steps(self):
+        from pytorch_ddp_template_tpu.utils.profiler import (
+            annotate, current_phase, set_phase_annotations,
+        )
+
+        try:
+            set_phase_annotations(False)
+            with annotate("eval"):
+                assert current_phase() == "between_steps"
+        finally:
+            set_phase_annotations(True)
+
+
+# -- fingerprint direction ---------------------------------------------------
+
+class TestPeakHbmFingerprint:
+    def test_peak_hbm_in_fingerprint_and_direction(self):
+        from pytorch_ddp_template_tpu.obs.regression import (
+            compare_fingerprints, make_fingerprint,
+        )
+
+        prior = make_fingerprint(timer_summary={"step_time_p50_ms": 10.0},
+                                 peak_hbm_bytes=1e9)
+        worse = make_fingerprint(timer_summary={"step_time_p50_ms": 10.0},
+                                 peak_hbm_bytes=1.5e9)
+        warns = compare_fingerprints(prior, worse, threshold_pct=20.0)
+        assert warns and "peak_hbm_bytes" in warns[0]
+        # shrinking memory is an improvement, not a regression
+        better = make_fingerprint(timer_summary={"step_time_p50_ms": 10.0},
+                                  peak_hbm_bytes=0.5e9)
+        assert compare_fingerprints(prior, better, threshold_pct=20.0) == []
+        # absent on either side: skipped, never invented
+        no_mem = make_fingerprint(timer_summary={"step_time_p50_ms": 10.0})
+        assert compare_fingerprints(no_mem, worse, threshold_pct=20.0) == []
+
+
+# -- config ------------------------------------------------------------------
+
+class TestMemConfig:
+    def test_budget_frac_bounds(self):
+        from pytorch_ddp_template_tpu.config import TrainingConfig
+
+        with pytest.raises(ValueError, match="mem_budget_frac"):
+            TrainingConfig(mem_budget_frac=0.0)
+        with pytest.raises(ValueError, match="mem_budget_frac"):
+            TrainingConfig(mem_budget_frac=1.1)
+        TrainingConfig(mem_budget_frac=1.0)  # inclusive top
+
+    def test_mem_report_needs_a_cadence(self):
+        from pytorch_ddp_template_tpu.config import TrainingConfig
+
+        with pytest.raises(ValueError, match="cadence"):
+            TrainingConfig(mem_report=True, logging_steps=0, perf_every=0)
+        TrainingConfig(mem_report=True, logging_steps=0, perf_every=5)
+
+    def test_cli_flags_parse(self):
+        from pytorch_ddp_template_tpu.config import parse_args
+
+        cfg = parse_args(["--mem_report", "--mem_budget_frac", "0.8"])
+        assert cfg.mem_report
+        assert cfg.mem_budget_frac == 0.8
+
+
+# -- /metrics gauges ---------------------------------------------------------
+
+class TestPrometheusMemGauges:
+    def test_per_device_hbm_gauges(self):
+        from pytorch_ddp_template_tpu.obs.server import prometheus_lines
+
+        text = prometheus_lines({
+            "host": 0, "step": 5,
+            "records": {"mem": {"mem_bytes_in_use": 500.0,
+                                "mem_frac_of_limit": 0.5,
+                                "mem_bytes_in_use_per_device": [500.0]}},
+            "memory": {
+                "watermark_bytes": 700.0, "limit_bytes": 1000.0,
+                "pressure_active": False,
+                "devices": [
+                    {"device": 0, "bytes_in_use": 500,
+                     "peak_bytes_in_use": 700, "bytes_limit": 1000},
+                    {"device": 1, "bytes_in_use": 400,
+                     "peak_bytes_in_use": 600, "bytes_limit": 1000},
+                ],
+                "static": {"split": {"projected_peak_bytes": 900}},
+            },
+        })
+        # per-device family under its OWN names: the host-level record
+        # gauges (tpuddp_mem_bytes_in_use{host}) and the per-device
+        # samples must not share a metric name, or PromQL sums over the
+        # family double-count
+        assert 'tpuddp_mem_device_bytes_in_use{host="0",device="0"} 500' in text
+        assert 'tpuddp_mem_device_bytes_in_use{host="0",device="1"} 400' in text
+        assert 'tpuddp_mem_device_peak_bytes{host="0",device="1"} 600' in text
+        assert 'tpuddp_mem_device_limit_bytes{host="0",device="0"} 1000' in text
+        assert 'tpuddp_mem_bytes_in_use{host="0"} 500' in text  # record gauge
+        assert 'tpuddp_mem_bytes_in_use{host="0",device' not in text
+        assert "tpuddp_mem_watermark_bytes" in text
+        assert "tpuddp_mem_watermark_frac_of_limit" in text
+        assert "tpuddp_mem_pressure_active" in text
+        assert "tpuddp_mem_projected_peak_bytes" in text
+        # the per-device vector in the record is a JSONL-only channel
+        assert "per_device" not in text
+
+    def test_no_memory_section_no_invented_gauges(self):
+        from pytorch_ddp_template_tpu.obs.server import prometheus_lines
+
+        text = prometheus_lines({"host": 0, "step": 1, "records": {}})
+        assert "tpuddp_mem_" not in text
+
+
+# -- engine integration ------------------------------------------------------
+
+def make_trainer(out_dir, **overrides):
+    from pytorch_ddp_template_tpu.config import TrainingConfig
+    from pytorch_ddp_template_tpu.models import build
+    from pytorch_ddp_template_tpu.runtime import init as rt_init
+    from pytorch_ddp_template_tpu.train.engine import Trainer
+
+    cfg = TrainingConfig(**{
+        "model": "mlp", "mesh": "data:8",
+        "per_device_train_batch_size": 4, "dataset_size": 512,
+        "max_steps": 8, "logging_steps": 2, "save_steps": 0,
+        "resume": False, "warmup_steps": 0, "max_grad_norm": 1000.0,
+        "output_dir": str(out_dir), **overrides})
+    ctx = rt_init(cfg)
+    task, ds = build(cfg.model, cfg, mesh=ctx.mesh)
+    return Trainer(cfg, ctx, task, ds)
+
+
+class TestEngineMemory:
+    def test_mem_records_through_production_drain(self, tmp_path):
+        """--mem_report on CPU: the static-degradation mem records land
+        in metrics.jsonl (labelled mem_measured=0), the compile split +
+        donation audit land on the monitor, and the clean-exit baseline
+        carries peak_hbm_bytes."""
+        t = make_trainer(tmp_path, mem_report=True)
+        t.train()
+        st = t.memory.state()
+        split = (st["static"] or {}).get("split")
+        assert split and split["argument_bytes"] > 0
+        audit = st["static"]["donation"]
+        assert audit["available"] and audit["undonated_leaves"] == 0
+        assert audit["donated_leaves"] > 0
+        recs = [json.loads(l) for l in
+                (tmp_path / "metrics.jsonl").read_text().splitlines()]
+        mem_recs = [r for r in recs if "mem_measured" in r]
+        assert mem_recs, "no kind=mem records reached the writer"
+        assert all(r["mem_measured"] == 0.0 for r in mem_recs)  # CPU
+        assert mem_recs[0]["mem_projected_peak_bytes"] == pytest.approx(
+            split["projected_peak_bytes"])
+        bl = json.loads((tmp_path / "perf_baseline.json").read_text())
+        assert bl["fingerprint"]["peak_hbm_bytes"] == pytest.approx(
+            split["projected_peak_bytes"])
+
+    def test_mem_pressure_trigger_to_bundle(self, tmp_path):
+        """A faked memory_stats crossing the budget mid-run must ride
+        the drain-thread tripwire into the sentry and dump a triage
+        bundle with kind=mem_pressure and memory.json forensics — in
+        warn mode the run completes."""
+        from pytorch_ddp_template_tpu.obs.sentry import BUNDLE_FILES
+
+        t = make_trainer(tmp_path, mem_report=True, anomaly="warn",
+                         max_steps=24)
+        t.memory._poll = fake_poll_seq([0.5, 0.97], limit=10**9)
+        state = t.train()
+        assert int(state.step) == 24  # warn mode: the run completes
+        bundles = sorted((tmp_path / "flight_records").glob("step_*"))
+        assert len(bundles) == 1
+        names = {p.name for p in bundles[0].iterdir()}
+        assert set(BUNDLE_FILES) <= names
+        assert "memory.json" in names
+        trig = json.loads((bundles[0] / "trigger.json").read_text())
+        assert trig["kind"] == "mem_pressure"
+        assert trig["scalars"]["frac_of_limit"] == 0.97
+        assert "--mem_budget_frac" in trig["reasons"][0]
+        mem = json.loads((bundles[0] / "memory.json").read_text())
+        assert mem["census"]["available"]
+        assert mem["static_model"]["split"]["argument_bytes"] > 0
+        assert mem["records"], "the last-K mem ring is missing"
+
+    def test_oom_crash_dumps_forensics(self, tmp_path):
+        """An allocation-failure exception mid-loop must leave a crash
+        bundle whose memory.json carries the census AND the compile-time
+        split — the production flight-recorder path, no bench scaffolding."""
+        t = make_trainer(tmp_path, mem_report=True, anomaly="warn",
+                         max_steps=16)
+        orig = t.train_step
+        calls = {"n": 0}
+
+        def poisoned(state, batch, *rest):
+            calls["n"] += 1
+            if calls["n"] == 4:
+                raise RuntimeError(
+                    "RESOURCE_EXHAUSTED: Out of memory allocating "
+                    "99999999 bytes")
+            return orig(state, batch, *rest)
+
+        poisoned.lower = orig.lower
+        t.train_step = poisoned
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            t.train()
+        bundles = sorted((tmp_path / "flight_records").glob("step_*"))
+        assert bundles
+        trig = json.loads((bundles[0] / "trigger.json").read_text())
+        assert trig["mode"] == "crash"
+        assert trig["oom"] is True
+        mem = json.loads((bundles[0] / "memory.json").read_text())
+        assert mem["census"]["available"]
+        assert mem["census"]["n_arrays"] > 0
+        assert mem["static_model"]["split"]["temp_bytes"] is not None
+
+    def test_oom_crash_without_mem_report_still_gets_census(self, tmp_path):
+        t = make_trainer(tmp_path, anomaly="warn", max_steps=16)
+        orig = t.train_step
+
+        def poisoned(state, batch, *rest):
+            raise MemoryError("host allocator gave up")
+
+        t.train_step = poisoned
+        with pytest.raises(MemoryError):
+            t.train()
+        bundles = sorted((tmp_path / "flight_records").glob("step_*"))
+        assert bundles
+        mem = json.loads((bundles[0] / "memory.json").read_text())
+        assert mem["census"]["available"]
+        assert mem["static_model"] is None  # nothing invented
+
+    def test_non_oom_crash_without_monitor_has_no_memory_json(self, tmp_path):
+        t = make_trainer(tmp_path, anomaly="warn", max_steps=16)
+
+        def poisoned(state, batch, *rest):
+            raise ValueError("not a memory problem")
+
+        t.train_step = poisoned
+        with pytest.raises(ValueError):
+            t.train()
+        bundles = sorted((tmp_path / "flight_records").glob("step_*"))
+        assert bundles
+        assert not (bundles[0] / "memory.json").exists()
+
+    def test_tampered_baseline_memory_regression_warns(
+            self, tmp_path, monkeypatch):
+        """The r14 restore-compare convention, memory edition: attempt 1
+        writes perf_baseline.json with peak_hbm_bytes; a tampered (much
+        smaller) baseline makes attempt 2 WARN that the memory footprint
+        regressed — even though nothing about its speed changed."""
+        from pytorch_ddp_template_tpu.train import engine
+
+        t = make_trainer(tmp_path, mem_report=True, max_steps=24)
+        t.train()
+        path = tmp_path / "perf_baseline.json"
+        doc = json.loads(path.read_text())
+        fp = doc["fingerprint"]
+        assert fp["peak_hbm_bytes"] > 0
+        # tamper: claim the prior attempt fit in a tenth of the memory
+        fp["peak_hbm_bytes"] = fp["peak_hbm_bytes"] / 10.0
+        # keep the step-time signals in-band so ONLY memory regresses
+        path.write_text(json.dumps(doc))
+
+        warned = []
+        monkeypatch.setattr(
+            engine.log, "warning",
+            lambda msg, *a: warned.append(str(msg)))
+        t2 = make_trainer(tmp_path, mem_report=True, max_steps=24,
+                          regression_pct=20.0)
+        t2.train()
+        regs = [w for w in warned if "perf regression" in w]
+        assert regs, "no regression warning for the grown memory footprint"
+        assert any("peak_hbm_bytes" in w for w in regs)
+
+    def test_status_endpoint_serves_memory(self, tmp_path):
+        import urllib.request
+
+        t = make_trainer(tmp_path, mem_report=True, status_port=-1,
+                         status_host="127.0.0.1", max_steps=60)
+        t.memory._poll = fake_poll_seq([0.5], limit=10**9)
+        snap = {}
+        metrics_text = [""]
+        orig = t.train_step
+
+        def probing(state, batch, *rest):
+            out = orig(state, batch, *rest)
+            if not snap and t.status is not None and t.status.port:
+                try:
+                    body = urllib.request.urlopen(
+                        f"http://127.0.0.1:{t.status.port}/status",
+                        timeout=2).read().decode()
+                    s = json.loads(body)
+                    if (s.get("memory") or {}).get("polls", 0) > 0:
+                        snap.update(s)
+                        metrics_text[0] = urllib.request.urlopen(
+                            f"http://127.0.0.1:{t.status.port}/metrics",
+                            timeout=2).read().decode()
+                except Exception:  # noqa: BLE001 - retry next step
+                    pass
+            return out
+
+        probing.lower = orig.lower
+        t.train_step = probing
+        t.train()
+        assert snap, "no /status snapshot with memory polls was captured"
+        assert snap["memory"]["watermark_bytes"] == 5e8
+        assert "tpuddp_mem_device_bytes_in_use" in metrics_text[0]
+        assert "tpuddp_mem_watermark_bytes" in metrics_text[0]

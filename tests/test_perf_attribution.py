@@ -71,6 +71,53 @@ class TestStaticCostModel:
 
         assert cost_of(Broken()) == {"flops": 0.0, "bytes": 0.0}
 
+    def test_missing_cost_analysis_keys_yield_zeros(self):
+        """r15 satellite: a backend whose cost_analysis returns a dict
+        WITHOUT the 'flops'/'bytes accessed' keys (or an empty list)
+        must degrade to zeros — and zeros must propagate to 'no figure'
+        downstream, never an invented estimate."""
+        class MissingKeys:
+            def cost_analysis(self):
+                return {"utilization": 0.5}  # neither flops nor bytes
+
+        class EmptyList:
+            def cost_analysis(self):
+                return []
+
+        assert cost_of(MissingKeys()) == {"flops": 0.0, "bytes": 0.0}
+        assert cost_of(EmptyList()) == {"flops": 0.0, "bytes": 0.0}
+        cm = static_cost_model(MissingKeys(), {"data": 1}, hlo_text="")
+        assert cm["flops_per_step"] == 0.0
+        assert cm["hbm_bytes_per_step"] == 0.0
+        # the attribution built on that model emits NO mfu/hbm figures
+        attr = PerfAttribution(cm, device_kind="TPU v5e", n_devices=1)
+        snap = attr.interval(wall_s=1.0, steps=10, device_wait_s=0.5)
+        assert "perf_mfu" not in snap
+        assert "perf_tflops_per_sec" not in snap
+        assert "perf_hbm_gbps" not in snap
+        # the fractions still sum to 1 (device share is all compute)
+        assert (snap["perf_frac_compute"] + snap["perf_frac_comm"]
+                + snap["perf_frac_host"]
+                + snap["perf_frac_input"]) == pytest.approx(1.0, abs=2e-3)
+
+    def test_unknown_hardware_yields_no_mfu_or_memory_figure(self):
+        """Unknown device_kind: peak/ICI/HBM lookups are all None —
+        MFU, wire rate context and HBM-fraction must be ABSENT (the
+        absolute hbm_gbps estimate from cost analysis is still honest),
+        never computed against an invented peak."""
+        attr = PerfAttribution(
+            {"flops_per_step": 1e9, "hbm_bytes_per_step": 1e8,
+             "wire_bytes_total": 0},
+            device_kind="weird-npu-9000", n_devices=4)
+        assert attr.peak_flops is None
+        assert attr.ici_bytes_per_sec is None
+        assert attr.hbm_bytes_per_sec is None
+        snap = attr.interval(wall_s=1.0, steps=10, device_wait_s=0.5)
+        assert "perf_mfu" not in snap
+        assert "perf_hbm_frac_of_peak" not in snap
+        assert snap["perf_hbm_gbps"] > 0  # measured-ish, not peak-relative
+        assert snap["perf_frac_comm"] == 0.0  # no bandwidth: all compute
+
 
 class TestPeakLookup:
     def test_override_wins(self):
@@ -219,6 +266,33 @@ class TestGoodputLedger:
         (tmp_path / "goodput.json").write_text("{not json")
         led = GoodputLedger(tmp_path)  # must not raise
         assert led.attempt == 1
+
+    def test_clock_skew_gap_clamps_to_zero_and_warns_once(
+            self, tmp_path, monkeypatch):
+        """r15 satellite: a restart on a clock-skewed host can see the
+        prior attempt's heartbeat in the FUTURE — the negative downtime
+        gap must clamp to 0 (never a negative `halted` bucket in
+        goodput.json) and log one warning naming the skew."""
+        from pytorch_ddp_template_tpu.obs import goodput as gp_mod
+
+        first = GoodputLedger(tmp_path)
+        first.add("productive_step", 10.0)
+        first.flush()
+        warned = []
+        monkeypatch.setattr(gp_mod.log, "warning",
+                            lambda msg, *a: warned.append(str(msg)))
+        # this attempt's wall clock reads 300s BEFORE the heartbeat
+        second = GoodputLedger(tmp_path, now=time.time() - 300.0)
+        assert second.attempt == 2
+        assert second.totals()["halted"] == 0.0
+        assert len(warned) == 1
+        assert "clock skew" in warned[0]
+        second.flush()
+        rec = json.loads((tmp_path / "goodput.json").read_text())
+        assert rec["buckets"]["halted"] >= 0.0
+        # and the normal positive-gap path is untouched
+        third = GoodputLedger(tmp_path, now=time.time() + 30.0)
+        assert third.totals()["halted"] == pytest.approx(30.0, abs=2.0)
 
     def test_rate_limited_flush(self, tmp_path):
         led = GoodputLedger(tmp_path)

@@ -1,10 +1,11 @@
 #!/usr/bin/env python
 """Diff two bench-record sets; exit non-zero on out-of-band drift.
 
-The 19 committed ``bench_records/*.jsonl`` files document every round's
+The committed ``bench_records/*.jsonl`` files document every round's
 evidence — but documentation does not fail CI. This tool turns them into
 executable perf-regression tripwires (the r14 fleet-watchtower
-convention, the CLI sibling of ``obs/regression.py``):
+convention, the CLI sibling of ``obs/regression.py``;
+``tools/ci_bench_check.sh`` is the one-command CI wrapper):
 
     # a fresh record vs the committed one (the BENCH_MODE=fleet leg)
     python tools/bench_diff.py bench_records/perf_cpu_r13.jsonl /tmp/new.jsonl

@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# One-command bench regression gate (r15 satellite): wire
+# tools/bench_diff.py over the committed bench_records/ as a CI check.
+#
+#   bash tools/ci_bench_check.sh                 # self-check: committed
+#                                                # records vs themselves
+#                                                # (must exit 0 — proves
+#                                                # the tripwire is armed
+#                                                # and the records parse)
+#   bash tools/ci_bench_check.sh /tmp/fresh      # gate: fresh records
+#                                                # (a dir or .jsonl of
+#                                                # bench.py output) vs
+#                                                # the committed ones
+#   TOLERANCE=0.15 bash tools/ci_bench_check.sh /tmp/fresh
+#
+# Exit codes are bench_diff's: 0 in-band, 1 drift, 2 no overlap/usage
+# (an empty comparison must not read as green). Output is the github
+# markdown table — paste-ready for a PR comment / CI job summary.
+set -u
+cd "$(dirname "$0")/.."
+R=bench_records
+CANDIDATE=${1:-$R}
+TOLERANCE=${TOLERANCE:-0.25}
+
+python tools/bench_diff.py "$R" "$CANDIDATE" \
+  --tolerance "$TOLERANCE" --format github
+rc=$?
+if [ "$CANDIDATE" = "$R" ] && [ "$rc" -eq 0 ]; then
+  echo >&2
+  echo "self-check passed: committed records parse and are in-band vs themselves" >&2
+  echo "(run with a fresh records dir to gate new numbers: tools/ci_bench_check.sh <dir>)" >&2
+fi
+exit $rc

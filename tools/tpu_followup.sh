@@ -1,0 +1,254 @@
+#!/usr/bin/env bash
+# Consolidated TPU measurement suite (r15 satellite): ONE parameterized
+# script replacing the accumulating per-round tpu_followup_rN.sh copies
+# (the old spellings remain as thin shims so committed docs keep
+# working). `bash tools/tpu_followup.sh <round>` runs the historical
+# chain for that round — the same legs, outfiles and env the per-round
+# scripts recorded:
+#
+#   round 4/5  : just that round's legs (the pre-chain era)
+#   round 6    : the r6 e2e host-overhead pairs, then the r4/r5 backlogs
+#   round >= 7 : the r6 e2e HEADLINE pair FIRST (still the open headline
+#                — per the round-5 verdict's "headline number first"
+#                directive), then the r7 legs, the r4/r5 backlogs, then
+#                each later round's legs in order up to <round>
+#
+# Per-round notes (degenerate markers, multi-chip prerequisites, what a
+# 1-chip tunnel can and cannot prove) live in the legs_rN functions
+# below, carried over verbatim from the originals. Safe to re-run; each
+# bench mode appends one JSON line to its round's records file.
+# Usage: bash tools/tpu_followup.sh <round>   (requires the axon tunnel)
+set -u
+ROUND=${1:?usage: tpu_followup.sh <round: 4..15>}
+case "$ROUND" in (*[!0-9]*|'') echo "round must be a number, got '$ROUND'" >&2; exit 2;; esac
+if [ "$ROUND" -lt 4 ] || [ "$ROUND" -gt 15 ]; then
+  echo "unknown round $ROUND (expected 4..15)" >&2; exit 2
+fi
+cd "$(dirname "$0")/.."
+R=bench_records
+mkdir -p "$R"
+ERR="$R/.followup_r${ROUND}.err"
+RC=0
+
+run() { # name, outfile, timeout_s, env... — one JSON line or the error
+  local name=$1 out=$2 to=$3; shift 3
+  echo "=== $name ===" >&2
+  env "$@" timeout "$to" python bench.py 2>>"$ERR" | tee -a "$R/$out"
+  local rc=${PIPESTATUS[0]}
+  [ "$rc" -ne 0 ] && { echo "leg $name exited rc=$rc" >&2; RC=1; }
+}
+
+# the XLA latency-hiding-scheduler flag pack the r8-r11 A/B legs toggle
+LHS="--xla_tpu_enable_latency_hiding_scheduler=true --xla_tpu_enable_async_collective_fusion=true --xla_tpu_enable_async_collective_fusion_fuse_all_gather=true --xla_tpu_enable_async_collective_fusion_multiple_steps=true --xla_tpu_overlap_compute_collective_tc=true --xla_enable_async_all_gather=true"
+
+headline_e2e() {
+  # the r6 e2e host-overhead pair on the flagship config — recorded
+  # FIRST on every tunnel window since r7 ("headline number first")
+  run e2e_sync  host_overhead_tpu_r6.jsonl 900 BENCH_MODE=e2e BENCH_MODEL=resnet50 BENCH_LOG_STEPS=1 BENCH_TELEMETRY=sync
+  run e2e_async host_overhead_tpu_r6.jsonl 900 BENCH_MODE=e2e BENCH_MODEL=resnet50 BENCH_LOG_STEPS=1 BENCH_TELEMETRY=async
+}
+
+legs_r4() {
+  # flash seq sweep (incl. the backward kernels), bert under the
+  # dispatch policy, TPU e2e, long-context in situ, fused-head ablation
+  run flash512  followup_tpu_r4.jsonl 900 BENCH_MODE=flash BENCH_SEQ=512
+  run flash1024 followup_tpu_r4.jsonl 900 BENCH_MODE=flash BENCH_SEQ=1024
+  run flash2048 followup_tpu_r4.jsonl 900 BENCH_MODE=flash BENCH_SEQ=2048
+  run flash4096 followup_tpu_r4.jsonl 900 BENCH_MODE=flash BENCH_SEQ=4096
+  run bert      followup_tpu_r4.jsonl 900 BENCH_MODE=train BENCH_MODEL=bert-base
+  run e2e_rn50  followup_tpu_r4.jsonl 900 BENCH_MODE=e2e BENCH_MODEL=resnet50
+  run gpt_long  followup_tpu_r4.jsonl 900 BENCH_MODE=train BENCH_MODEL=gpt-long BENCH_BATCH=1 BENCH_STEPS=10
+  run gpt_small followup_tpu_r4.jsonl 900 BENCH_MODE=train BENCH_MODEL=gpt-small
+  run gpt_small_fused followup_tpu_r4.jsonl 900 BENCH_MODE=train BENCH_MODEL=gpt-small BENCH_FUSED_HEAD=1
+  run bert_fused followup_tpu_r4.jsonl 900 BENCH_MODE=train BENCH_MODEL=bert-base BENCH_FUSED_HEAD=1
+  echo "=== mfu_probe bert-base ===" >&2
+  timeout 900 python tools/mfu_probe.py --model bert-base --iters 10 \
+    | tee -a "$R/mfu_probe_bert_tpu_r4.jsonl" || RC=1
+}
+
+legs_r5() {
+  # the gpt-long fused-stack story: each lever ablated, plus fresh
+  # flagship numbers and the selective-remat mfu probes
+  run gpt_long_fused   train_tpu_r5.jsonl 900 BENCH_MODE=train BENCH_MODEL=gpt-long BENCH_BATCH=1 BENCH_STEPS=10
+  run gpt_long_dense   train_tpu_r5.jsonl 900 BENCH_MODE=train BENCH_MODEL=gpt-long BENCH_BATCH=1 BENCH_STEPS=10 BENCH_DENSE_HEAD=1
+  run gpt_long_noflash train_tpu_r5.jsonl 900 BENCH_MODE=train BENCH_MODEL=gpt-long BENCH_BATCH=1 BENCH_STEPS=10 FLASH_DISABLE=1
+  run gpt_long_dense_noflash train_tpu_r5.jsonl 900 BENCH_MODE=train BENCH_MODEL=gpt-long BENCH_BATCH=1 BENCH_STEPS=10 BENCH_DENSE_HEAD=1 FLASH_DISABLE=1
+  run flash4096_b4 train_tpu_r5.jsonl 900 BENCH_MODE=flash BENCH_SEQ=4096
+  run resnet50  train_tpu_r5.jsonl 900 BENCH_MODE=train BENCH_MODEL=resnet50
+  run gpt_small train_tpu_r5.jsonl 900 BENCH_MODE=train BENCH_MODEL=gpt-small
+  local flags
+  for flags in "" "--remat" "--remat --save-convs"; do
+    echo "=== mfu_probe resnet50 $flags ===" >&2
+    timeout 900 python tools/mfu_probe.py --model resnet50 --norm-dtype bf16 \
+      $flags | tee -a "$R/mfu_probe_tpu_r5.jsonl" || RC=1
+  done
+}
+
+legs_r6() {
+  # the full r6 pair set: flagship AND transformer (round >= 7 runs the
+  # flagship pair via headline_e2e instead and skips the gpt pair, as
+  # the historical r7+ scripts did)
+  headline_e2e
+  run e2e_sync_gpt  host_overhead_tpu_r6.jsonl 900 BENCH_MODE=e2e BENCH_MODEL=gpt-small BENCH_LOG_STEPS=1 BENCH_TELEMETRY=sync
+  run e2e_async_gpt host_overhead_tpu_r6.jsonl 900 BENCH_MODE=e2e BENCH_MODEL=gpt-small BENCH_LOG_STEPS=1 BENCH_TELEMETRY=async
+}
+
+legs_r7() {
+  # scan-over-layers: TPU compile sweep + deep-model step-time pairs
+  # (BENCH_DEPTH marks non-headline variants) + remat-scan memory pairs
+  run compile_sweep compile_scan_tpu_r7.jsonl 900 BENCH_MODE=compile
+  run deep24_unrolled compile_scan_tpu_r7.jsonl 900 BENCH_MODE=train BENCH_MODEL=gpt-small BENCH_DEPTH=24 BENCH_BATCH=4
+  run deep24_scanned  compile_scan_tpu_r7.jsonl 900 BENCH_MODE=train BENCH_MODEL=gpt-small BENCH_DEPTH=24 BENCH_BATCH=4 BENCH_SCAN=1
+  run deep24_remat_unrolled compile_scan_tpu_r7.jsonl 900 BENCH_MODE=train BENCH_MODEL=gpt-small BENCH_DEPTH=24 BENCH_BATCH=4 BENCH_REMAT=1
+  run deep24_remat_scanned  compile_scan_tpu_r7.jsonl 900 BENCH_MODE=train BENCH_MODEL=gpt-small BENCH_DEPTH=24 BENCH_BATCH=4 BENCH_REMAT=1 BENCH_SCAN=1
+}
+
+legs_r8() {
+  # decomposed FSDP (data:1 tunnel -> `degenerate` marker: no
+  # collectives to hide; still the schedule+parity probe on Mosaic)
+  run overlap_pair overlap_tpu_r8.jsonl 900 BENCH_MODE=overlap
+  run lhs_flags_off overlap_tpu_r8.jsonl 900 BENCH_MODE=train BENCH_MODEL=gpt-small BENCH_BATCH=4
+  run lhs_flags_on  overlap_tpu_r8.jsonl 900 BENCH_MODE=train BENCH_MODEL=gpt-small BENCH_BATCH=4 XLA_FLAGS="$LHS"
+}
+
+legs_r9() {
+  # compressed DDP comms (data:1 -> degenerate; parity + HLO probe)
+  run comms_legs comms_tpu_r9.jsonl 1200 BENCH_MODE=comms
+  run ddp_lhs_off comms_tpu_r9.jsonl 1200 BENCH_MODE=train BENCH_MODEL=gpt-small BENCH_BATCH=4 BENCH_SCAN=1 BENCH_DDP_OVERLAP=1
+  run ddp_lhs_on  comms_tpu_r9.jsonl 1200 BENCH_MODE=train BENCH_MODEL=gpt-small BENCH_BATCH=4 BENCH_SCAN=1 BENCH_DDP_OVERLAP=1 XLA_FLAGS="$LHS"
+}
+
+legs_r10() {
+  # decomposed TP (needs model:N>=2 — 1 chip emits the degenerate
+  # zero-value record; the lhs A/B fails harmlessly with intent)
+  run tp_legs tp_tpu_r10.jsonl 1200 BENCH_MODE=tp
+  run tp_lhs_off tp_tpu_r10.jsonl 1200 BENCH_MODE=train BENCH_MODEL=gpt-small BENCH_BATCH=4 BENCH_SCAN=1 BENCH_TP_OVERLAP=1
+  run tp_lhs_on  tp_tpu_r10.jsonl 1200 BENCH_MODE=train BENCH_MODEL=gpt-small BENCH_BATCH=4 BENCH_SCAN=1 BENCH_TP_OVERLAP=1 XLA_FLAGS="$LHS"
+}
+
+legs_r11() {
+  # composed fsdp×tp (needs data:N>=2 × model:M>=2; degenerate at 1)
+  run overlap3d_legs overlap3d_tpu_r11.jsonl 1200 BENCH_MODE=overlap3d
+  run o3d_lhs_off overlap3d_tpu_r11.jsonl 1200 BENCH_MODE=train BENCH_MODEL=gpt-small BENCH_BATCH=4 BENCH_SCAN=1 BENCH_TP_OVERLAP=1 BENCH_FSDP_OVERLAP=1
+  run o3d_lhs_on  overlap3d_tpu_r11.jsonl 1200 BENCH_MODE=train BENCH_MODEL=gpt-small BENCH_BATCH=4 BENCH_SCAN=1 BENCH_TP_OVERLAP=1 BENCH_FSDP_OVERLAP=1 XLA_FLAGS="$LHS"
+}
+
+legs_r12() {
+  # observability: chip-count-agnostic overhead pair + injected-NaN
+  # flight record, plus a real-Mosaic --hlo_report dump
+  run obs_legs obs_tpu_r12.jsonl 1200 BENCH_MODE=obs BENCH_MODEL=gpt-small BENCH_BATCH=4 BENCH_STEPS=20 BENCH_WARMUP=3
+  timeout 900 python ddp.py --model gpt-small --scan_layers --max_steps 4 \
+    --per_device_train_batch_size 4 --logging_steps 2 --save_steps 0 \
+    --dataset_size 512 --hlo_report --anomaly warn --no_resume \
+    --output_dir /tmp/obs_hlo_tpu_r12 2>>"$ERR" \
+    && cp /tmp/obs_hlo_tpu_r12/hlo_report.json "$R/hlo_report_tpu_r12.json" \
+    && echo "hlo report copied to $R/hlo_report_tpu_r12.json" >&2 || RC=1
+}
+
+legs_r13() {
+  # performance attribution: real MFU (v5e is in PEAK_FLOPS) +
+  # mfu_probe cross-check + a named-phase trace through the loop
+  run perf_legs perf_tpu_r13.jsonl 1800 BENCH_MODE=perf BENCH_MODEL=gpt-small BENCH_BATCH=4 BENCH_STEPS=20 BENCH_WARMUP=3 BENCH_LOG_STEPS=5
+  timeout 900 python tools/mfu_probe.py --model gpt-small --batch 4 \
+    2>>"$ERR" | tee -a "$R/perf_tpu_r13.jsonl" || RC=1
+  timeout 900 python ddp.py --model gpt-small --scan_layers --perf_report \
+    --profile_steps 6 --max_steps 30 --per_device_train_batch_size 4 \
+    --logging_steps 5 --save_steps 0 --dataset_size 2048 --no_resume \
+    --output_dir /tmp/perf_trace_tpu_r13 2>>"$ERR" \
+    && cp -r /tmp/perf_trace_tpu_r13/profile "$R/perf_trace_tpu_r13_profile" \
+    && cp /tmp/perf_trace_tpu_r13/goodput.json "$R/goodput_tpu_r13.json" \
+    && echo "trace + goodput copied into $R/" >&2 || RC=1
+}
+
+legs_r14() {
+  # fleet watchtower: neutrality + endpoints + injected straggler
+  # (exchange DEGENERATE on a 1-host tunnel — real rows need
+  # launch/run_pod.sh on >= 2 workers; throttle one worker and the
+  # verdict should name it with no injection), then a live watchtower
+  # run with /status + /metrics scraped next to the records, the
+  # perf_baseline restore-compare across two runs of one output_dir,
+  # and bench_diff over the fresh legs
+  run fleet_legs fleet_tpu_r14.jsonl 1800 BENCH_MODE=fleet BENCH_MODEL=gpt-small BENCH_BATCH=4 BENCH_STEPS=20 BENCH_WARMUP=3 BENCH_LOG_STEPS=5
+  timeout 900 python ddp.py --model gpt-small --scan_layers --perf_report \
+    --fleet --status_port 8090 --anomaly warn --max_steps 30 \
+    --per_device_train_batch_size 4 --logging_steps 5 --save_steps 0 \
+    --dataset_size 2048 --no_resume --output_dir /tmp/fleet_tpu_r14 \
+    2>>"$ERR" &
+  local train_pid=$!
+  sleep 45
+  curl -sf http://127.0.0.1:8090/status  > "$R/fleet_status_tpu_r14.json" \
+    2>>"$ERR" && echo "status scraped" >&2
+  curl -sf http://127.0.0.1:8090/metrics > "$R/fleet_metrics_tpu_r14.prom" \
+    2>>"$ERR" && echo "metrics scraped" >&2
+  wait "$train_pid" || RC=1
+  cp /tmp/fleet_tpu_r14/describe.json "$R/describe_tpu_r14.json" 2>/dev/null \
+    && echo "describe.json copied" >&2
+  cp /tmp/fleet_tpu_r14/perf_baseline.json "$R/perf_baseline_tpu_r14.json" \
+    2>/dev/null && echo "perf_baseline.json copied" >&2
+  timeout 900 python ddp.py --model gpt-small --scan_layers --perf_report \
+    --fleet --status_port 8090 --anomaly warn --max_steps 60 \
+    --per_device_train_batch_size 4 --logging_steps 5 --save_steps 30 \
+    --dataset_size 2048 --output_dir /tmp/fleet_tpu_r14 \
+    2>&1 | grep -a "perf regression\|goodput summary" >> "$ERR"
+  python tools/bench_diff.py "$R" "$R/fleet_tpu_r14.jsonl" --format github \
+    > "$R/bench_diff_tpu_r14.md" 2>>"$ERR" \
+    || echo "bench_diff flagged drift (see bench_diff_tpu_r14.md)" >&2
+}
+
+legs_r15() {
+  # memory X-ray: the r15 real-hardware data the CPU record cannot
+  # produce — (a) REAL memory_stats watermarks (the CPU record pins the
+  # static-degradation path only; on v5e the kind="mem" records carry
+  # true per-device bytes-in-use/peak/limit, the remat A/B gains a
+  # measured peak delta, and the /metrics HBM gauges export real
+  # numbers); (b) a production run whose perf_baseline.json carries a
+  # MEASURED peak_hbm_bytes; (c) the restore-compare on real hardware:
+  # rerun the same output_dir and attempt 2 should WARN iff the memory
+  # footprint drifted out of band (alongside the step-wall signals)
+  run mem_legs mem_tpu_r15.jsonl 1800 BENCH_MODE=mem BENCH_MODEL=gpt-small BENCH_BATCH=4 BENCH_STEPS=20 BENCH_WARMUP=3 BENCH_LOG_STEPS=5
+  timeout 900 python ddp.py --model gpt-small --scan_layers --mem_report \
+    --perf_report --status_port 8091 --anomaly warn --max_steps 30 \
+    --per_device_train_batch_size 4 --logging_steps 5 --save_steps 0 \
+    --dataset_size 2048 --no_resume --output_dir /tmp/mem_tpu_r15 \
+    2>>"$ERR" &
+  local train_pid=$!
+  sleep 45
+  curl -sf http://127.0.0.1:8091/metrics > "$R/mem_metrics_tpu_r15.prom" \
+    2>>"$ERR" && echo "mem /metrics scraped" >&2
+  curl -sf http://127.0.0.1:8091/status > "$R/mem_status_tpu_r15.json" \
+    2>>"$ERR" && echo "mem /status scraped" >&2
+  wait "$train_pid" || RC=1
+  cp /tmp/mem_tpu_r15/perf_baseline.json "$R/mem_baseline_tpu_r15.json" \
+    2>/dev/null && echo "perf_baseline (peak_hbm stamped) copied" >&2
+  timeout 900 python ddp.py --model gpt-small --scan_layers --mem_report \
+    --perf_report --max_steps 60 --per_device_train_batch_size 4 \
+    --logging_steps 5 --save_steps 30 --dataset_size 2048 \
+    --output_dir /tmp/mem_tpu_r15 \
+    2>&1 | grep -a "perf regression\|memory budget\|donation audit\|goodput summary" >> "$ERR"
+  python tools/bench_diff.py "$R" "$R/mem_tpu_r15.jsonl" --format github \
+    > "$R/bench_diff_tpu_r15.md" 2>>"$ERR" \
+    || echo "bench_diff flagged drift (see bench_diff_tpu_r15.md)" >&2
+}
+
+# -- the historical chain ---------------------------------------------------
+if [ "$ROUND" -eq 4 ]; then
+  legs_r4
+elif [ "$ROUND" -eq 5 ]; then
+  # the historical r5 poller ran the deferred r4 suite first
+  legs_r4; legs_r5
+elif [ "$ROUND" -eq 6 ]; then
+  legs_r6; legs_r4; legs_r5
+else
+  headline_e2e
+  legs_r7
+  legs_r4
+  legs_r5
+  r=8
+  while [ "$r" -le "$ROUND" ]; do
+    "legs_r$r"
+    r=$((r + 1))
+  done
+fi
+
+echo "done; round-$ROUND records in $R/ (see the legs_r$ROUND function for filenames)" >&2
+exit $RC

@@ -1,47 +1,7 @@
 #!/usr/bin/env bash
-# Round-10 TPU measurement suite. Ordering per the established pattern:
-# (1) the r9 backlog FIRST (tools/tpu_followup_r9.sh — itself chaining the
-# r8/r7 backlogs, headed by the still-open r6 e2e host-overhead headline
-# pair), then (2) the round-10 decomposed-TP legs on the real chip.
-# Note: the current tunnel exposes ONE v5e chip — BENCH_MODE=tp needs a
-# model:N>=2 mesh axis, so a single-chip run emits a `degenerate`
-# zero-value record (there is no TP collective to decompose, not even a
-# parity probe; the r8 convention). The real legs — ring-vs-GSPMD parity
-# on the Mosaic compiler, step-time ratio with actual ICI latency to
-# hide, and the ppermute schedule under the latency-hiding pack — stay
-# flagged for the next multi-chip tunnel window.
-# Safe to re-run; each mode appends one JSON line.
-# Usage: bash tools/tpu_followup_r10.sh   (requires the axon tunnel up)
-set -u
-cd "$(dirname "$0")/.."
-R=bench_records
-mkdir -p "$R"
-
-run() { # name, outfile, env... — logs one JSON line or the error
-  local name=$1 out=$2; shift 2
-  echo "=== $name ===" >&2
-  env "$@" timeout 1200 python bench.py 2>>"$R/.followup_r10.err" | tee -a "$R/$out"
-}
-
-# 1. the r9 backlog first (r8/r7 chain -> r9 comms legs)
-bash tools/tpu_followup_r9.sh
-rc9=$?
-
-# 2. round-10 decomposed-TP legs
-#    (a) BENCH_MODE=tp on the chip: degenerate marker at 1 chip; on a
-#        multi-chip slice this is the real record — default-vs-ring
-#        parity, fwd/bwd ppermute schedule evidence from the Mosaic
-#        compiler, the never-materialised-logits live range, and the
-#        step-time ratio with real ICI latency under the dots
-run tp_legs tp_tpu_r10.jsonl BENCH_MODE=tp
-#    (b) the latency-hiding-scheduler pack A/B over the decomposed-TP
-#        train step (multi-chip only — gpt-small heads/mlp divide
-#        model:2): whether the scheduler actually runs the single-hop
-#        ppermutes under the partial dots on real hardware. Harmless
-#        degenerate-config failure at 1 chip (refused with intent).
-run tp_lhs_off tp_tpu_r10.jsonl BENCH_MODE=train BENCH_MODEL=gpt-small BENCH_BATCH=4 BENCH_SCAN=1 BENCH_TP_OVERLAP=1
-run tp_lhs_on  tp_tpu_r10.jsonl BENCH_MODE=train BENCH_MODEL=gpt-small BENCH_BATCH=4 BENCH_SCAN=1 BENCH_TP_OVERLAP=1 \
-    XLA_FLAGS="--xla_tpu_enable_latency_hiding_scheduler=true --xla_tpu_enable_async_collective_fusion=true --xla_tpu_enable_async_collective_fusion_fuse_all_gather=true --xla_tpu_enable_async_collective_fusion_multiple_steps=true --xla_tpu_overlap_compute_collective_tc=true --xla_enable_async_all_gather=true"
-
-echo "done; r10 records in $R/tp_tpu_r10.jsonl" >&2
-exit $rc9
+# Thin shim (r15 consolidation): the per-round followup scripts now live
+# as one parameterized suite — tools/tpu_followup.sh <round> — with this
+# spelling kept so committed docs/BENCH.md commands keep working. The
+# round-10 legs (and the historical backlog chain before them) run
+# unchanged; see the legs_r10 function there.
+exec bash "$(dirname "$0")/tpu_followup.sh" 10

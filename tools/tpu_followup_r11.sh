@@ -1,50 +1,7 @@
 #!/usr/bin/env bash
-# Round-11 TPU measurement suite. Ordering per the established pattern:
-# (1) the r10 backlog FIRST (tools/tpu_followup_r10.sh — itself chaining
-# r9/r8/r7, headed by the still-open r6 e2e host-overhead headline pair
-# and the r10 TP legs that need a multi-chip slice), then (2) the
-# round-11 composed-schedule legs on the real chip.
-# Note: the current tunnel exposes ONE v5e chip — BENCH_MODE=overlap3d
-# needs a data:N>=2 × model:M>=2 mesh, so a single-chip run emits a
-# `degenerate` zero-value record (nothing to compose; the r8
-# convention). The real legs — composed fsdp-gathers-under-ring-dots
-# parity on the Mosaic compiler, the step-time ratio with BOTH axes'
-# collectives hidden by real ICI latency, and the latency-hiding pack
-# A/B over the composed step — stay flagged for the next multi-chip
-# tunnel window.
-# Safe to re-run; each mode appends one JSON line.
-# Usage: bash tools/tpu_followup_r11.sh   (requires the axon tunnel up)
-set -u
-cd "$(dirname "$0")/.."
-R=bench_records
-mkdir -p "$R"
-
-run() { # name, outfile, env... — logs one JSON line or the error
-  local name=$1 out=$2; shift 2
-  echo "=== $name ===" >&2
-  env "$@" timeout 1200 python bench.py 2>>"$R/.followup_r11.err" | tee -a "$R/$out"
-}
-
-# 1. the r10 backlog first (r9/r8/r7 chain -> r10 TP legs)
-bash tools/tpu_followup_r10.sh
-rc10=$?
-
-# 2. round-11 composed-schedule legs
-#    (a) BENCH_MODE=overlap3d on the chip: degenerate marker at 1 chip;
-#        on a multi-chip slice this is the real record — composed
-#        fsdp×tp parity vs the FLOPs-matched (remat) GSPMD default, the
-#        both-axes HLO schedule evidence from the Mosaic compiler, and
-#        the step-time ratio with real ICI latency under the dots
-run overlap3d_legs overlap3d_tpu_r11.jsonl BENCH_MODE=overlap3d
-#    (b) the latency-hiding-scheduler pack A/B over the composed
-#        fsdp×tp train step (multi-chip only — gpt-small heads/mlp
-#        divide model:2): whether the scheduler runs the data-axis
-#        gathers AND the single-hop ppermutes under the partial dots at
-#        the same time on real hardware. Harmless degenerate-config
-#        failure at 1 chip (refused with intent at mesh validation).
-run o3d_lhs_off overlap3d_tpu_r11.jsonl BENCH_MODE=train BENCH_MODEL=gpt-small BENCH_BATCH=4 BENCH_SCAN=1 BENCH_TP_OVERLAP=1 BENCH_FSDP_OVERLAP=1
-run o3d_lhs_on  overlap3d_tpu_r11.jsonl BENCH_MODE=train BENCH_MODEL=gpt-small BENCH_BATCH=4 BENCH_SCAN=1 BENCH_TP_OVERLAP=1 BENCH_FSDP_OVERLAP=1 \
-    XLA_FLAGS="--xla_tpu_enable_latency_hiding_scheduler=true --xla_tpu_enable_async_collective_fusion=true --xla_tpu_enable_async_collective_fusion_fuse_all_gather=true --xla_tpu_enable_async_collective_fusion_multiple_steps=true --xla_tpu_overlap_compute_collective_tc=true --xla_enable_async_all_gather=true"
-
-echo "done; r11 records in $R/overlap3d_tpu_r11.jsonl" >&2
-exit $rc10
+# Thin shim (r15 consolidation): the per-round followup scripts now live
+# as one parameterized suite — tools/tpu_followup.sh <round> — with this
+# spelling kept so committed docs/BENCH.md commands keep working. The
+# round-11 legs (and the historical backlog chain before them) run
+# unchanged; see the legs_r11 function there.
+exec bash "$(dirname "$0")/tpu_followup.sh" 11

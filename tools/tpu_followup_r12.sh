@@ -1,49 +1,7 @@
 #!/usr/bin/env bash
-# Round-12 TPU measurement suite. Ordering per the established pattern:
-# (1) the r11 backlog FIRST (tools/tpu_followup_r11.sh — itself chaining
-# r10/r9/r8/r7, headed by the still-open r6 e2e host-overhead headline
-# pair and the composed-schedule legs that need a multi-chip slice),
-# then (2) the round-12 observability legs on the real chip.
-# The obs legs are chip-count-agnostic: the overhead pair and the
-# injected-NaN flight-record proof run fine on ONE chip (unlike the
-# overlap modes) — the real-hardware datum here is the health-pack +
-# per-step-sentry cost against real TPU step times, where the device-
-# bound step dwarfs the host-side queue work far more than the noisy
-# CPU bench host does. The --hlo_report dump on the chip additionally
-# records what the Mosaic compiler's HLO looks like to the walkers.
-# Safe to re-run; each mode appends one JSON line.
-# Usage: bash tools/tpu_followup_r12.sh   (requires the axon tunnel up)
-set -u
-cd "$(dirname "$0")/.."
-R=bench_records
-mkdir -p "$R"
-
-run() { # name, outfile, env... — logs one JSON line or the error
-  local name=$1 out=$2; shift 2
-  echo "=== $name ===" >&2
-  env "$@" timeout 1200 python bench.py 2>>"$R/.followup_r12.err" | tee -a "$R/$out"
-}
-
-# 1. the r11 backlog first (r10/r9/r8/r7 chain -> composed-schedule legs)
-bash tools/tpu_followup_r11.sh
-rc11=$?
-
-# 2. round-12 observability legs
-#    (a) BENCH_MODE=obs on the chip: the health-pack+sentry overhead
-#        ratio against real device-bound steps (gpt-small — a compute-
-#        heavy step, so the pack's param-sized reductions are properly
-#        dwarfed) + the injected-NaN flight-record completeness proof
-run obs_legs obs_tpu_r12.jsonl BENCH_MODE=obs BENCH_MODEL=gpt-small BENCH_BATCH=4 BENCH_STEPS=20 BENCH_WARMUP=3
-#    (b) a real-TPU --hlo_report dump: the startup schedule report from
-#        the Mosaic-compiled train step (scan-over-layers so the walkers
-#        see the scanned body). The report lands in the run's output dir;
-#        copy it next to the records for the round's evidence.
-timeout 900 python ddp.py --model gpt-small --scan_layers --max_steps 4 \
-  --per_device_train_batch_size 4 --logging_steps 2 --save_steps 0 \
-  --dataset_size 512 --hlo_report --anomaly warn --no_resume \
-  --output_dir /tmp/obs_hlo_tpu_r12 2>>"$R/.followup_r12.err" \
-  && cp /tmp/obs_hlo_tpu_r12/hlo_report.json "$R/hlo_report_tpu_r12.json" \
-  && echo "hlo report copied to $R/hlo_report_tpu_r12.json" >&2
-
-echo "done; r12 records in $R/obs_tpu_r12.jsonl" >&2
-exit $rc11
+# Thin shim (r15 consolidation): the per-round followup scripts now live
+# as one parameterized suite — tools/tpu_followup.sh <round> — with this
+# spelling kept so committed docs/BENCH.md commands keep working. The
+# round-12 legs (and the historical backlog chain before them) run
+# unchanged; see the legs_r12 function there.
+exec bash "$(dirname "$0")/tpu_followup.sh" 12

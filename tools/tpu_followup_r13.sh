@@ -1,60 +1,7 @@
 #!/usr/bin/env bash
-# Round-13 TPU measurement suite. Ordering per the established pattern:
-# (1) the r12 backlog FIRST (tools/tpu_followup_r12.sh — itself chaining
-# r11/r10/r9/r8/r7, headed by the still-open r6 e2e host-overhead
-# headline pair), then (2) the round-13 performance-attribution legs on
-# the real chip. The r13 real-hardware data this CPU host cannot
-# produce: (a) a REAL MFU — the CPU record's calibrated peak proves
-# pipeline consistency only; on v5e the PEAK_FLOPS table entry applies
-# and the reported perf_mfu is a true model-FLOPs utilisation, directly
-# comparable to tools/mfu_probe.py's number for the same config;
-# (b) a trace with the named loop/schedule phases — the --perf_report
-# --profile_steps run below leaves a profile whose host lanes read
-# input_wait / train_step_dispatch / device_wait and whose device lanes
-# carry the sched_* named scopes (copy the profile dir next to the
-# records for the round's evidence); (c) real compute/comm splits — on
-# a multi-chip slice the ICI table engages and perf_frac_comm becomes
-# meaningful (single chip: wire bytes 0, frac_comm 0, flagged by the
-# record's mesh fields, the r8 degenerate convention).
-# Safe to re-run; each mode appends one JSON line.
-# Usage: bash tools/tpu_followup_r13.sh   (requires the axon tunnel up)
-set -u
-cd "$(dirname "$0")/.."
-R=bench_records
-mkdir -p "$R"
-
-run() { # name, outfile, env... — logs one JSON line or the error
-  local name=$1 out=$2; shift 2
-  echo "=== $name ===" >&2
-  env "$@" timeout 1800 python bench.py 2>>"$R/.followup_r13.err" | tee -a "$R/$out"
-}
-
-# 1. the r12 backlog first (r11/r10/r9/r8/r7 chain -> obs legs)
-bash tools/tpu_followup_r12.sh
-rc12=$?
-
-# 2. round-13 performance-attribution legs
-#    (a) BENCH_MODE=perf on the chip: neutrality pair against real
-#        device-bound steps + a REAL MFU (v5e is in the PEAK_FLOPS
-#        table, so no calibration — mfu_reported is the true number)
-run perf_legs perf_tpu_r13.jsonl BENCH_MODE=perf BENCH_MODEL=gpt-small BENCH_BATCH=4 BENCH_STEPS=20 BENCH_WARMUP=3 BENCH_LOG_STEPS=5
-#    (b) cross-check: tools/mfu_probe.py full_step MFU for the same
-#        config must agree with (a)'s mfu_reported (both are model
-#        FLOPs / wall / peak; disagreement means the attribution
-#        interval math drifted from the probe's fenced timing)
-timeout 900 python tools/mfu_probe.py --model gpt-small --batch 4 \
-  2>>"$R/.followup_r13.err" | tee -a "$R/perf_tpu_r13.jsonl"
-#    (c) a named-phase trace: --perf_report + --profile_steps through
-#        the production loop; the profile lands in the run dir — copy
-#        it next to the records (host lanes: input_wait/dispatch/
-#        device_wait; device lanes: sched_* scopes)
-timeout 900 python ddp.py --model gpt-small --scan_layers --perf_report \
-  --profile_steps 6 --max_steps 30 --per_device_train_batch_size 4 \
-  --logging_steps 5 --save_steps 0 --dataset_size 2048 --no_resume \
-  --output_dir /tmp/perf_trace_tpu_r13 2>>"$R/.followup_r13.err" \
-  && cp -r /tmp/perf_trace_tpu_r13/profile "$R/perf_trace_tpu_r13_profile" \
-  && cp /tmp/perf_trace_tpu_r13/goodput.json "$R/goodput_tpu_r13.json" \
-  && echo "trace + goodput copied into $R/" >&2
-
-echo "done; r13 records in $R/perf_tpu_r13.jsonl" >&2
-exit $rc12
+# Thin shim (r15 consolidation): the per-round followup scripts now live
+# as one parameterized suite — tools/tpu_followup.sh <round> — with this
+# spelling kept so committed docs/BENCH.md commands keep working. The
+# round-13 legs (and the historical backlog chain before them) run
+# unchanged; see the legs_r13 function there.
+exec bash "$(dirname "$0")/tpu_followup.sh" 13

@@ -1,77 +1,7 @@
 #!/usr/bin/env bash
-# Round-14 TPU measurement suite. Ordering per the established pattern:
-# (1) the r13 backlog FIRST (tools/tpu_followup_r13.sh — itself chaining
-# r12/r11/r10/r9/r8/r7, headed by the still-open r6 e2e host-overhead
-# headline pair), then (2) the round-14 fleet-watchtower legs on the
-# real chip. The r14 real-hardware data this CPU host cannot produce:
-# (a) a REAL multi-host fleet exchange — the CPU record's allgather is
-# skipped by construction (one process), so the wire path of
-# obs/fleet.py (jax.experimental.multihost_utils.process_allgather on
-# the telemetry drain thread) only exercises on a multi-host pod; run
-# the BENCH_MODE=fleet leg under launch/run_pod.sh on >= 2 workers and
-# the fleet table gains real per-host rows (single-host tunnel: the leg
-# below is DEGENERATE on the exchange — still valid for overhead +
-# endpoints + the injected-straggler bundle);
-# (b) real straggler attribution — on a pod, throttle one worker (e.g.
-# `nice -n 19` its process or pin it to fewer cores) and the verdict
-# should name THAT host with no injection;
-# (c) a REAL perf_baseline restore-compare — rerun the same output_dir
-# across two tunnel sessions and the second attempt should WARN iff the
-# chip/mesh/wheel changed the steady step wall by > --regression_pct.
-# Safe to re-run; each mode appends one JSON line.
-# Usage: bash tools/tpu_followup_r14.sh   (requires the axon tunnel up)
-set -u
-cd "$(dirname "$0")/.."
-R=bench_records
-mkdir -p "$R"
-
-run() { # name, outfile, env... — logs one JSON line or the error
-  local name=$1 out=$2; shift 2
-  echo "=== $name ===" >&2
-  env "$@" timeout 1800 python bench.py 2>>"$R/.followup_r14.err" | tee -a "$R/$out"
-}
-
-# 1. the r13 backlog first (r12/r11/r10/r9/r8/r7 chain -> perf legs)
-bash tools/tpu_followup_r13.sh
-rc13=$?
-
-# 2. round-14 fleet-watchtower legs
-#    (a) BENCH_MODE=fleet on the chip: neutrality pair against real
-#        device-bound steps + live endpoint scrape + injected-straggler
-#        bundle (exchange DEGENERATE on a 1-host tunnel — flagged by
-#        the record's n_processes field)
-run fleet_legs fleet_tpu_r14.jsonl BENCH_MODE=fleet BENCH_MODEL=gpt-small BENCH_BATCH=4 BENCH_STEPS=20 BENCH_WARMUP=3 BENCH_LOG_STEPS=5
-#    (b) a real production run with the full watchtower on — fleet +
-#        status endpoint + anomaly sentry + perf report — and a scrape
-#        of /status and /metrics copied next to the records
-timeout 900 python ddp.py --model gpt-small --scan_layers --perf_report \
-  --fleet --status_port 8090 --anomaly warn --max_steps 30 \
-  --per_device_train_batch_size 4 --logging_steps 5 --save_steps 0 \
-  --dataset_size 2048 --no_resume --output_dir /tmp/fleet_tpu_r14 \
-  2>>"$R/.followup_r14.err" &
-train_pid=$!
-sleep 45
-curl -sf http://127.0.0.1:8090/status  > "$R/fleet_status_tpu_r14.json" \
-  2>>"$R/.followup_r14.err" && echo "status scraped" >&2
-curl -sf http://127.0.0.1:8090/metrics > "$R/fleet_metrics_tpu_r14.prom" \
-  2>>"$R/.followup_r14.err" && echo "metrics scraped" >&2
-wait "$train_pid"
-cp /tmp/fleet_tpu_r14/describe.json "$R/describe_tpu_r14.json" 2>/dev/null \
-  && echo "describe.json copied" >&2
-cp /tmp/fleet_tpu_r14/perf_baseline.json "$R/perf_baseline_tpu_r14.json" \
-  2>/dev/null && echo "perf_baseline.json copied" >&2
-#    (c) the restore-compare tripwire: rerun the SAME output_dir with a
-#        larger budget; attempt 2 compares against (b)'s baseline and
-#        WARNs iff the steady step wall drifted out of band
-timeout 900 python ddp.py --model gpt-small --scan_layers --perf_report \
-  --fleet --status_port 8090 --anomaly warn --max_steps 60 \
-  --per_device_train_batch_size 4 --logging_steps 5 --save_steps 30 \
-  --dataset_size 2048 --output_dir /tmp/fleet_tpu_r14 \
-  2>&1 | grep -a "perf regression\|goodput summary" >> "$R/.followup_r14.err"
-#    (d) the committed records as tripwires against the fresh TPU legs
-python tools/bench_diff.py "$R" "$R/fleet_tpu_r14.jsonl" --format github \
-  > "$R/bench_diff_tpu_r14.md" 2>>"$R/.followup_r14.err" \
-  || echo "bench_diff flagged drift (see bench_diff_tpu_r14.md)" >&2
-
-echo "done; r14 records in $R/fleet_tpu_r14.jsonl" >&2
-exit $rc13
+# Thin shim (r15 consolidation): the per-round followup scripts now live
+# as one parameterized suite — tools/tpu_followup.sh <round> — with this
+# spelling kept so committed docs/BENCH.md commands keep working. The
+# round-14 legs (and the historical backlog chain before them) run
+# unchanged; see the legs_r14 function there.
+exec bash "$(dirname "$0")/tpu_followup.sh" 14

@@ -1,51 +1,7 @@
 #!/usr/bin/env bash
-# Round-4 TPU follow-up suite: runs the measurements that were blocked by
-# the tunnel outage. Safe to re-run; each mode appends one JSON line.
-# Usage: bash tools/tpu_followup_r4.sh   (requires the axon tunnel up)
-set -u
-cd "$(dirname "$0")/.."
-R=bench_records
-mkdir -p "$R"
-
-run() { # name, env..., — logs one JSON line or the error
-  local name=$1; shift
-  echo "=== $name ===" >&2
-  env "$@" timeout 900 python bench.py 2>>"$R/.followup.err" | tee -a "$R/followup_tpu_r4.jsonl"
-}
-
-# 1. flash at seq 512: decides whether FLASH_MIN_SEQ can drop to 512
-#    (bert-base regime; policy currently routes 512 to XLA, unmeasured)
-run flash512 BENCH_MODE=flash BENCH_SEQ=512
-
-# 1b. re-record flash at 1024/2048/4096: the mode now also times the
-#     Pallas backward kernels (bwd_* columns), absent from flash_tpu_r4
-run flash1024 BENCH_MODE=flash BENCH_SEQ=1024
-run flash2048 BENCH_MODE=flash BENCH_SEQ=2048
-run flash4096 BENCH_MODE=flash BENCH_SEQ=4096
-
-# 2. bert-base train under the current dispatch policy (XLA at 512) —
-#    compare with the pre-policy record 208.08 seq/s (train_tpu_r4.jsonl)
-run bert BENCH_MODE=train BENCH_MODEL=bert-base
-
-# 3. e2e vs cached-batch on the flagship: quantify the input path on TPU
-run e2e_rn50 BENCH_MODE=e2e BENCH_MODEL=resnet50
-
-# 4. long-context single chip: gpt-long trains with flash at 4096 in situ
-run gpt_long BENCH_MODE=train BENCH_MODEL=gpt-long BENCH_BATCH=1 BENCH_STEPS=10
-
-# 5. gpt-small re-measure: its seq-1024 training step now runs the Pallas
-#    flash BACKWARD kernels too (record to compare vs 91.9 seq/s pre-bwd)
-run gpt_small BENCH_MODE=train BENCH_MODEL=gpt-small
-
-# 5b. blockwise LM head ablation on hardware: throughput with/without the
-#     (B,T,V) logits tensor (memory win is proven; is there a time cost?)
-run gpt_small_fused BENCH_MODE=train BENCH_MODEL=gpt-small BENCH_FUSED_HEAD=1
-run bert_fused BENCH_MODE=train BENCH_MODEL=bert-base BENCH_FUSED_HEAD=1
-
-# 6. transformer MFU decomposition on TPU-compiled HLO (the CPU probe is
-#    unrepresentative here: different fusion, dense attention matrices)
-echo "=== mfu_probe bert-base ===" >&2
-timeout 900 python tools/mfu_probe.py --model bert-base --iters 10 \
-  | tee -a "$R/mfu_probe_bert_tpu_r4.jsonl"
-
-echo "done; records in $R/followup_tpu_r4.jsonl" >&2
+# Thin shim (r15 consolidation): the per-round followup scripts now live
+# as one parameterized suite — tools/tpu_followup.sh <round> — with this
+# spelling kept so committed docs/BENCH.md commands keep working. The
+# round-4 legs (and the historical backlog chain before them) run
+# unchanged; see the legs_r4 function there.
+exec bash "$(dirname "$0")/tpu_followup.sh" 4

@@ -1,46 +1,7 @@
 #!/usr/bin/env bash
-# Round-5 TPU measurement suite: the gpt-long fused-stack story
-# (VERDICT r4 next-step #3) plus a fresh flagship number. Run AFTER
-# tools/tpu_followup_r4.sh (which re-records flash incl. both backward
-# impls, bert-base under the dispatch policy, and TPU e2e).
-# Safe to re-run; each mode appends one JSON line.
-# Usage: bash tools/tpu_followup_r5.sh   (requires the axon tunnel up)
-set -u
-cd "$(dirname "$0")/.."
-R=bench_records
-mkdir -p "$R"
-
-run() { # name, env..., — logs one JSON line or the error
-  local name=$1; shift
-  echo "=== $name ===" >&2
-  env "$@" timeout 900 python bench.py 2>>"$R/.followup_r5.err" | tee -a "$R/train_tpu_r5.jsonl"
-}
-
-# 1. the long-context flagship composition the blockwise head + flash +
-#    remat exist for: throughput, MFU, and the executable's own memory
-#    breakdown (temp_mb), each lever ablated against its baseline
-run gpt_long_fused   BENCH_MODE=train BENCH_MODEL=gpt-long BENCH_BATCH=1 BENCH_STEPS=10
-run gpt_long_dense   BENCH_MODE=train BENCH_MODEL=gpt-long BENCH_BATCH=1 BENCH_STEPS=10 BENCH_DENSE_HEAD=1
-run gpt_long_noflash BENCH_MODE=train BENCH_MODEL=gpt-long BENCH_BATCH=1 BENCH_STEPS=10 FLASH_DISABLE=1
-run gpt_long_dense_noflash BENCH_MODE=train BENCH_MODEL=gpt-long BENCH_BATCH=1 BENCH_STEPS=10 BENCH_DENSE_HEAD=1 FLASH_DISABLE=1
-
-# 2. flash backward on real Mosaic, pinned pallas, long-context shape —
-#    the Mosaic compile + parity + timing record that decides FLASH_BWD's
-#    hardware default (r4's flash mode re-records 512-4096; this adds the
-#    bwd-heavy batch-4 case)
-run flash4096_b4 BENCH_MODE=flash BENCH_SEQ=4096
-
-# 3. fresh flagship ladder numbers for BENCH_r05 context (bf16-BN resnet50
-#    is the headline; gpt-small exercises the new bwd default on hardware)
-run resnet50  BENCH_MODE=train BENCH_MODEL=resnet50
-run gpt_small BENCH_MODE=train BENCH_MODEL=gpt-small
-
-# 4. the resnet50 MFU lever the roofline analysis names (selective remat:
-#    save conv outputs, recompute norm/ReLU) — probe all three schedules
-for flags in "" "--remat" "--remat --save-convs"; do
-  echo "=== mfu_probe resnet50 $flags ===" >&2
-  timeout 900 python tools/mfu_probe.py --model resnet50 --norm-dtype bf16 \
-    $flags | tee -a "$R/mfu_probe_tpu_r5.jsonl"
-done
-
-echo "done; records in $R/train_tpu_r5.jsonl + mfu_probe_tpu_r5.jsonl" >&2
+# Thin shim (r15 consolidation): the per-round followup scripts now live
+# as one parameterized suite — tools/tpu_followup.sh <round> — with this
+# spelling kept so committed docs/BENCH.md commands keep working. The
+# round-5 legs (and the historical backlog chain before them) run
+# unchanged; see the legs_r5 function there.
+exec bash "$(dirname "$0")/tpu_followup.sh" 5

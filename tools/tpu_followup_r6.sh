@@ -1,41 +1,7 @@
 #!/usr/bin/env bash
-# Round-6 TPU measurement suite. Per the round-5 verdict's "headline number
-# first" directive: the FIRST thing a fresh tunnel window records is the
-# BENCH_MODE=e2e before/after pair for the host-sync-free hot loop
-# (telemetry sync vs async, same model/batch/steps — host_overhead_pct is
-# the datum), THEN the deferred r4/r5 suites run. Safe to re-run; each mode
-# appends one JSON line.
-# Usage: bash tools/tpu_followup_r6.sh   (requires the axon tunnel up)
-set -u
-cd "$(dirname "$0")/.."
-R=bench_records
-mkdir -p "$R"
-
-run() { # name, env..., — logs one JSON line or the error
-  local name=$1; shift
-  echo "=== $name ===" >&2
-  env "$@" timeout 900 python bench.py 2>>"$R/.followup_r6.err" | tee -a "$R/host_overhead_tpu_r6.jsonl"
-}
-
-# 1. HEADLINE FIRST: the e2e host-overhead pair on the flagship config.
-#    sync = the pre-change loop (inline float conversions at every logging
-#    interval); async = device arrays drained off-thread + bounded
-#    dispatch-depth barrier. host_overhead_pct(sync) - host_overhead_pct(async)
-#    is the hot-loop win on real hardware.
-run e2e_sync  BENCH_MODE=e2e BENCH_MODEL=resnet50 BENCH_LOG_STEPS=1 BENCH_TELEMETRY=sync
-run e2e_async BENCH_MODE=e2e BENCH_MODEL=resnet50 BENCH_LOG_STEPS=1 BENCH_TELEMETRY=async
-
-# 2. same pair on the transformer flagship (larger step: the overlap win
-#    is proportionally smaller but the dispatch-pipeline protection shows
-#    in p99, which the full-loop leg logs via StepTimer)
-run e2e_sync_gpt  BENCH_MODE=e2e BENCH_MODEL=gpt-small BENCH_LOG_STEPS=1 BENCH_TELEMETRY=sync
-run e2e_async_gpt BENCH_MODE=e2e BENCH_MODEL=gpt-small BENCH_LOG_STEPS=1 BENCH_TELEMETRY=async
-
-# 3. then the deferred round-4/5 backlogs, unchanged
-bash tools/tpu_followup_r4.sh
-rc4=$?
-bash tools/tpu_followup_r5.sh
-rc5=$?
-
-echo "done; r6 records in $R/host_overhead_tpu_r6.jsonl" >&2
-exit $(( rc4 > rc5 ? rc4 : rc5 ))
+# Thin shim (r15 consolidation): the per-round followup scripts now live
+# as one parameterized suite — tools/tpu_followup.sh <round> — with this
+# spelling kept so committed docs/BENCH.md commands keep working. The
+# round-6 legs (and the historical backlog chain before them) run
+# unchanged; see the legs_r6 function there.
+exec bash "$(dirname "$0")/tpu_followup.sh" 6

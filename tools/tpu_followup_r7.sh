@@ -1,49 +1,7 @@
 #!/usr/bin/env bash
-# Round-7 TPU measurement suite. Ordering per the "headline number first"
-# directive: (1) the r6 headline e2e host-overhead pair (still the open
-# headline — two rounds of dead tunnel), then (2) the round-7 scan-over-
-# layers legs: the compile-time pair on the TPU backend (the CPU pair is
-# already committed in bench_records/compile_scan_cpu_r7.jsonl; the TPU
-# compiler is the number production cares about) and a deep-model
-# (24-layer gpt-small) step-time pair proving the scan is throughput-
-# neutral on real hardware, then (3) the deferred r4/r5 backlogs.
-# Safe to re-run; each mode appends one JSON line.
-# Usage: bash tools/tpu_followup_r7.sh   (requires the axon tunnel up)
-set -u
-cd "$(dirname "$0")/.."
-R=bench_records
-mkdir -p "$R"
-
-run() { # name, outfile, env... — logs one JSON line or the error
-  local name=$1 out=$2; shift 2
-  echo "=== $name ===" >&2
-  env "$@" timeout 900 python bench.py 2>>"$R/.followup_r7.err" | tee -a "$R/$out"
-}
-
-# 1. HEADLINE FIRST: the r6 e2e host-overhead pair on the flagship config.
-run e2e_sync  host_overhead_tpu_r6.jsonl BENCH_MODE=e2e BENCH_MODEL=resnet50 BENCH_LOG_STEPS=1 BENCH_TELEMETRY=sync
-run e2e_async host_overhead_tpu_r6.jsonl BENCH_MODE=e2e BENCH_MODEL=resnet50 BENCH_LOG_STEPS=1 BENCH_TELEMETRY=async
-
-# 2. round-7 scan-over-layers legs
-#    (a) compile-time sweep, unrolled vs scanned at depth 2/12/24, on the
-#        TPU compiler (Mosaic/XLA:TPU pays more per block than XLA:CPU, so
-#        the win should be LARGER here than the committed CPU pair)
-run compile_sweep compile_scan_tpu_r7.jsonl BENCH_MODE=compile
-#    (b) deep-model step-time pair: gpt-small at 24 layers, unrolled vs
-#        scanned (BENCH_DEPTH marks the records as non-headline variants);
-#        scan_layers must be throughput-neutral within run-to-run noise
-run deep24_unrolled compile_scan_tpu_r7.jsonl BENCH_MODE=train BENCH_MODEL=gpt-small BENCH_DEPTH=24 BENCH_BATCH=4
-run deep24_scanned  compile_scan_tpu_r7.jsonl BENCH_MODE=train BENCH_MODEL=gpt-small BENCH_DEPTH=24 BENCH_BATCH=4 BENCH_SCAN=1
-#    (c) remat-scan memory evidence: same deep pair with remat on — the
-#        memory_analysis fields (temp_mb) in the record are the datum
-run deep24_remat_unrolled compile_scan_tpu_r7.jsonl BENCH_MODE=train BENCH_MODEL=gpt-small BENCH_DEPTH=24 BENCH_BATCH=4 BENCH_REMAT=1
-run deep24_remat_scanned  compile_scan_tpu_r7.jsonl BENCH_MODE=train BENCH_MODEL=gpt-small BENCH_DEPTH=24 BENCH_BATCH=4 BENCH_REMAT=1 BENCH_SCAN=1
-
-# 3. then the deferred round-4/5 backlogs, unchanged
-bash tools/tpu_followup_r4.sh
-rc4=$?
-bash tools/tpu_followup_r5.sh
-rc5=$?
-
-echo "done; r7 records in $R/compile_scan_tpu_r7.jsonl" >&2
-exit $(( rc4 > rc5 ? rc4 : rc5 ))
+# Thin shim (r15 consolidation): the per-round followup scripts now live
+# as one parameterized suite — tools/tpu_followup.sh <round> — with this
+# spelling kept so committed docs/BENCH.md commands keep working. The
+# round-7 legs (and the historical backlog chain before them) run
+# unchanged; see the legs_r7 function there.
+exec bash "$(dirname "$0")/tpu_followup.sh" 7

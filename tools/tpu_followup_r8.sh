@@ -1,43 +1,7 @@
 #!/usr/bin/env bash
-# Round-8 TPU measurement suite. Ordering per the established pattern:
-# (1) the r7 backlog FIRST (tools/tpu_followup_r7.sh — itself headed by the
-# still-open r6 e2e host-overhead headline pair, then the r7 scan-over-
-# layers compile/step-time legs, then r4/r5), then (2) the round-8
-# decomposed-FSDP overlap legs on the real chip. Note: the current tunnel
-# exposes ONE v5e chip — at data:1 the overlap record is marked
-# `degenerate` (no collectives to hide) and serves as the schedule+parity
-# probe on real hardware; the step-time WIN case needs a multi-chip slice
-# and stays flagged for the next topology change. The latency-hiding
-# scheduler flag pack is exercised via a paired train run (flags off/on).
-# Safe to re-run; each mode appends one JSON line.
-# Usage: bash tools/tpu_followup_r8.sh   (requires the axon tunnel up)
-set -u
-cd "$(dirname "$0")/.."
-R=bench_records
-mkdir -p "$R"
-
-run() { # name, outfile, env... — logs one JSON line or the error
-  local name=$1 out=$2; shift 2
-  echo "=== $name ===" >&2
-  env "$@" timeout 900 python bench.py 2>>"$R/.followup_r8.err" | tee -a "$R/$out"
-}
-
-# 1. the r7 backlog first (r6 e2e headline pair -> r7 legs -> r4/r5)
-bash tools/tpu_followup_r7.sh
-rc7=$?
-
-# 2. round-8 overlap legs
-#    (a) BENCH_MODE=overlap on the chip: bit-parity + HLO schedule
-#        evidence + memory legs against the TPU compiler (degenerate
-#        step-time at data:1; still the first real-Mosaic record)
-run overlap_pair overlap_tpu_r8.jsonl BENCH_MODE=overlap
-#    (b) the latency-hiding-scheduler flag pack A/B on the flagship
-#        config: same train-mode bench with and without the pack — the
-#        XLA_FLAGS half of the overlap story, meaningful even at 1 chip
-#        (async collectives also overlap H2D/D2H and infeed)
-run lhs_flags_off overlap_tpu_r8.jsonl BENCH_MODE=train BENCH_MODEL=gpt-small BENCH_BATCH=4
-run lhs_flags_on  overlap_tpu_r8.jsonl BENCH_MODE=train BENCH_MODEL=gpt-small BENCH_BATCH=4 \
-    XLA_FLAGS="--xla_tpu_enable_latency_hiding_scheduler=true --xla_tpu_enable_async_collective_fusion=true --xla_tpu_enable_async_collective_fusion_fuse_all_gather=true --xla_tpu_enable_async_collective_fusion_multiple_steps=true --xla_tpu_overlap_compute_collective_tc=true --xla_enable_async_all_gather=true"
-
-echo "done; r8 records in $R/overlap_tpu_r8.jsonl" >&2
-exit $rc7
+# Thin shim (r15 consolidation): the per-round followup scripts now live
+# as one parameterized suite — tools/tpu_followup.sh <round> — with this
+# spelling kept so committed docs/BENCH.md commands keep working. The
+# round-8 legs (and the historical backlog chain before them) run
+# unchanged; see the legs_r8 function there.
+exec bash "$(dirname "$0")/tpu_followup.sh" 8

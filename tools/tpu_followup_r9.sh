@@ -1,45 +1,7 @@
 #!/usr/bin/env bash
-# Round-9 TPU measurement suite. Ordering per the established pattern:
-# (1) the r8 backlog FIRST (tools/tpu_followup_r8.sh — itself chaining the
-# r7 backlog, headed by the still-open r6 e2e host-overhead headline
-# pair), then (2) the round-9 compressed-DDP comms legs on the real chip.
-# Note: the current tunnel exposes ONE v5e chip — at data:1 the comms
-# record is marked `degenerate` (no cross-replica gradient bytes exist to
-# compress or overlap) and serves as the parity + HLO-schedule probe
-# against the real TPU compiler; the step-time and wire-bytes WIN cases
-# need a multi-chip slice and stay flagged for the next topology change
-# (per the r8 convention). The latency-hiding-scheduler pack pairs with
-# --ddp_overlap the same way it pairs with --fsdp_overlap.
-# Safe to re-run; each mode appends one JSON line.
-# Usage: bash tools/tpu_followup_r9.sh   (requires the axon tunnel up)
-set -u
-cd "$(dirname "$0")/.."
-R=bench_records
-mkdir -p "$R"
-
-run() { # name, outfile, env... — logs one JSON line or the error
-  local name=$1 out=$2; shift 2
-  echo "=== $name ===" >&2
-  env "$@" timeout 1200 python bench.py 2>>"$R/.followup_r9.err" | tee -a "$R/$out"
-}
-
-# 1. the r8 backlog first (r7 chain -> r8 overlap legs)
-bash tools/tpu_followup_r8.sh
-rc8=$?
-
-# 2. round-9 comms legs
-#    (a) BENCH_MODE=comms on the chip: fp32 bit-parity + per-layer
-#        in-scan HLO reduce evidence + wire-byte table + the EF
-#        convergence triple against the real TPU compiler (step-time
-#        ratio degenerate at data:1; still the first real-Mosaic record)
-run comms_legs comms_tpu_r9.jsonl BENCH_MODE=comms
-#    (b) the latency-hiding-scheduler pack A/B over the compressed-DDP
-#        train step: gpt-small --scan_layers --ddp_overlap with and
-#        without the pack — whether the scheduler actually drains the
-#        per-layer reduces under backward compute on real hardware
-run ddp_lhs_off comms_tpu_r9.jsonl BENCH_MODE=train BENCH_MODEL=gpt-small BENCH_BATCH=4 BENCH_SCAN=1 BENCH_DDP_OVERLAP=1
-run ddp_lhs_on  comms_tpu_r9.jsonl BENCH_MODE=train BENCH_MODEL=gpt-small BENCH_BATCH=4 BENCH_SCAN=1 BENCH_DDP_OVERLAP=1 \
-    XLA_FLAGS="--xla_tpu_enable_latency_hiding_scheduler=true --xla_tpu_enable_async_collective_fusion=true --xla_tpu_enable_async_collective_fusion_fuse_all_gather=true --xla_tpu_enable_async_collective_fusion_multiple_steps=true --xla_tpu_overlap_compute_collective_tc=true --xla_enable_async_all_gather=true"
-
-echo "done; r9 records in $R/comms_tpu_r9.jsonl" >&2
-exit $rc8
+# Thin shim (r15 consolidation): the per-round followup scripts now live
+# as one parameterized suite — tools/tpu_followup.sh <round> — with this
+# spelling kept so committed docs/BENCH.md commands keep working. The
+# round-9 legs (and the historical backlog chain before them) run
+# unchanged; see the legs_r9 function there.
+exec bash "$(dirname "$0")/tpu_followup.sh" 9

@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Consolidated tunnel poller (r15 satellite): one parameterized script
+# replacing the per-round tpu_poller_rN.sh copies (old spellings remain
+# as thin shims). Probes the axon relay port every 60s; when it answers
+# twice in a row (10s apart), runs `tools/tpu_followup.sh <round>` once
+# and exits with its status. The followup chains the full historical
+# backlog for the round (headline e2e pair first, then r7/r4/r5, then
+# r8..<round> — see tools/tpu_followup.sh). Gives up after ~11 h.
+# Usage: bash tools/tpu_poller.sh <round>
+set -u
+ROUND=${1:?usage: tpu_poller.sh <round: 4..15>}
+cd "$(dirname "$0")/.."
+probe() { timeout 2 bash -c '</dev/tcp/127.0.0.1/8082' 2>/dev/null; }
+deadline=$(( $(date +%s) + 39600 ))
+while [ "$(date +%s)" -lt "$deadline" ]; do
+  if probe; then
+    sleep 10
+    if probe; then
+      echo "tunnel up at $(date -u +%FT%TZ); running round-$ROUND followup suite" >&2
+      bash tools/tpu_followup.sh "$ROUND"
+      exit $?
+    fi
+  fi
+  sleep 60
+done
+echo "poller gave up: tunnel never answered" >&2
+exit 3

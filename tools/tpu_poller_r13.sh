@@ -1,27 +1,4 @@
 #!/usr/bin/env bash
-# Round-13 tunnel poller: probe the axon relay port every 60s; when it
-# answers twice in a row (10s apart), run the round-13 suite once and
-# exit. The r13 suite chains the r12 backlog FIRST (which itself leads
-# with the r11/r10/r9/r8/r7 chains and the r6 e2e headline pair), then
-# records the performance-attribution legs — the BENCH_MODE=perf
-# neutrality pair with a REAL v5e MFU (no calibration: the PEAK_FLOPS
-# table applies), the mfu_probe cross-check, and a --perf_report +
-# --profile_steps run whose trace carries the named loop/schedule
-# phases. Gives up after ~11 h.
-set -u
-cd "$(dirname "$0")/.."
-probe() { timeout 2 bash -c '</dev/tcp/127.0.0.1/8082' 2>/dev/null; }
-deadline=$(( $(date +%s) + 39600 ))
-while [ "$(date +%s)" -lt "$deadline" ]; do
-  if probe; then
-    sleep 10
-    if probe; then
-      echo "tunnel up at $(date -u +%FT%TZ); running r13 followup suite" >&2
-      bash tools/tpu_followup_r13.sh
-      exit $?
-    fi
-  fi
-  sleep 60
-done
-echo "poller gave up: tunnel never answered" >&2
-exit 3
+# Thin shim (r15 consolidation): see tools/tpu_poller.sh — this spelling
+# kept so committed docs keep working.
+exec bash "$(dirname "$0")/tpu_poller.sh" 13

@@ -1,29 +1,4 @@
 #!/usr/bin/env bash
-# Round-14 tunnel poller: probe the axon relay port every 60s; when it
-# answers twice in a row (10s apart), run the round-14 suite once and
-# exit. The r14 suite chains the r13 backlog FIRST (which itself leads
-# with the r12/r11/r10/r9/r8/r7 chains and the r6 e2e headline pair),
-# then records the fleet-watchtower legs — the BENCH_MODE=fleet
-# neutrality pair with live /status + /metrics scrapes, the
-# injected-straggler bundle, the perf_baseline restore-compare across
-# two runs of one output_dir, and tools/bench_diff.py over the
-# committed records (fleet exchange DEGENERATE on a 1-host tunnel; real
-# multi-host rows need launch/run_pod.sh on >= 2 workers). Gives up
-# after ~11 h.
-set -u
-cd "$(dirname "$0")/.."
-probe() { timeout 2 bash -c '</dev/tcp/127.0.0.1/8082' 2>/dev/null; }
-deadline=$(( $(date +%s) + 39600 ))
-while [ "$(date +%s)" -lt "$deadline" ]; do
-  if probe; then
-    sleep 10
-    if probe; then
-      echo "tunnel up at $(date -u +%FT%TZ); running r14 followup suite" >&2
-      bash tools/tpu_followup_r14.sh
-      exit $?
-    fi
-  fi
-  sleep 60
-done
-echo "poller gave up: tunnel never answered" >&2
-exit 3
+# Thin shim (r15 consolidation): see tools/tpu_poller.sh — this spelling
+# kept so committed docs keep working.
+exec bash "$(dirname "$0")/tpu_poller.sh" 14

@@ -1,24 +1,4 @@
 #!/usr/bin/env bash
-# Round-5 tunnel poller: probe the axon relay port every 60s; when it answers
-# twice in a row (10s apart), run the deferred round-4 TPU suite once and exit.
-# Gives up after ~11 h.
-set -u
-cd "$(dirname "$0")/.."
-probe() { timeout 2 bash -c '</dev/tcp/127.0.0.1/8082' 2>/dev/null; }
-deadline=$(( $(date +%s) + 39600 ))
-while [ "$(date +%s)" -lt "$deadline" ]; do
-  if probe; then
-    sleep 10
-    if probe; then
-      echo "tunnel up at $(date -u +%FT%TZ); running followup suites" >&2
-      bash tools/tpu_followup_r4.sh
-      rc4=$?
-      bash tools/tpu_followup_r5.sh
-      rc5=$?
-      exit $(( rc4 > rc5 ? rc4 : rc5 ))
-    fi
-  fi
-  sleep 60
-done
-echo "poller gave up: tunnel never answered" >&2
-exit 3
+# Thin shim (r15 consolidation): see tools/tpu_poller.sh — this spelling
+# kept so committed docs keep working.
+exec bash "$(dirname "$0")/tpu_poller.sh" 5
