@@ -64,9 +64,23 @@ class TrainingConfig:
     adam_eps: float = 1e-8
     mesh: str = "data:-1"  # mesh spec, e.g. "data:-1" or "data:4,model:2"
     cp_impl: str = "ring"  # context-parallel engine: ring | ulysses
-    pipe_microbatches: int = 4  # GPipe microbatch count for the pipelined
+    pipe_microbatches: int = 4  # microbatch count for the pipelined
     #                             entries (models/gpt_pipe.py); clamped to
-    #                             divide the per-replica batch
+    #                             divide the per-replica batch (a clamp
+    #                             to 1 is refused — the pipeline would
+    #                             serialise)
+    pipe_schedule: str = "1f1b"  # pipeline schedule for the pipelined
+    #                              entries (parallel/pipeline.py):
+    #                              gpipe (masked fill/drain, AD backward
+    #                              — the r4 parity/bench baseline) |
+    #                              1f1b (fused one-forward-one-backward
+    #                              slot loop, O(P) activation residency)
+    #                              | zb (zero-bubble: backward split
+    #                              into the critical-path dx pass and
+    #                              dw products deferred wholesale to a
+    #                              batched post-loop wave — the drain
+    #                              region doing the work the bubble
+    #                              used to waste)
     zero1: bool = False  # shard optimizer state over the data axis (ZeRO-1)
     fsdp: bool = False  # shard params+grads+opt state over data (FSDP/ZeRO-3;
     #                     subsumes zero1)
@@ -340,6 +354,16 @@ class TrainingConfig:
                 "full-width grads, but the ddp×tp drain reduces "
                 "model-sharded slices; drop one of the two"
             )
+        if self.pipe_schedule not in ("gpipe", "1f1b", "zb"):
+            raise ValueError(
+                f"unknown --pipe_schedule {self.pipe_schedule!r}; "
+                "expected gpipe | 1f1b | zb"
+            )
+        if self.pipe_microbatches < 1:
+            raise ValueError(
+                f"--pipe_microbatches must be >= 1, got "
+                f"{self.pipe_microbatches}"
+            )
         if self.perf_every < 0:
             raise ValueError(
                 f"--perf_every must be >= 0, got {self.perf_every} "
@@ -555,9 +579,24 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="Context-parallel attention engine over the seq "
                         "axis: ring (ppermute) or ulysses (all-to-all).")
     p.add_argument("--pipe_microbatches", type=int, default=4,
-                   help="GPipe microbatch count for the pipelined entries "
-                        "(more microbatches shrink the fill/drain bubble; "
-                        "clamped to divide the per-replica batch).")
+                   help="Microbatch count for the pipelined entries "
+                        "(more microbatches shrink the pipeline bubble; "
+                        "clamped to divide the per-replica batch — a "
+                        "clamp to 1 is refused, the pipeline would "
+                        "serialise).")
+    p.add_argument("--pipe_schedule", type=str, default="1f1b",
+                   choices=["gpipe", "1f1b", "zb"],
+                   help="Pipeline schedule for the pipelined entries "
+                        "(parallel/pipeline.py): 'gpipe' = masked "
+                        "fill/drain with AD backward (the round-4 "
+                        "baseline; O(M) activation residency); '1f1b' = "
+                        "fused one-forward-one-backward slot loop "
+                        "(Megatron 1F1B; O(P) residency, per-microbatch "
+                        "loss on the last stage inside the schedule); "
+                        "'zb' = zero-bubble: backward split into the "
+                        "critical-path dx pass and dw products deferred "
+                        "to a batched post-loop wave filling the drain "
+                        "region (ZB-H1 lineage).")
     p.add_argument("--zero1", action="store_true",
                    help="Shard optimizer state over the data axis (ZeRO-1): "
                         "momentum/Adam memory divided by the DP degree.")

@@ -1,45 +1,74 @@
 """Pipeline-parallel causal LM: the user-launchable PP path.
 
-The reference has no pipeline parallelism (SURVEY.md §2b: "PP: No") and
-round 4 left the GPipe mechanism library-only (``parallel/pipeline.py`` +
-tests, nothing a user could launch — VERDICT.md round-4 weak #3). This
-module closes that: ``--model gpt-pipe-tiny --mesh data:4,pipe:2`` trains
-a decoder-only LM whose transformer block stack runs as a GPipe
-fill/drain pipeline over the ``pipe`` mesh axis, through the ordinary
-:class:`~..train.engine.Trainer`.
+The reference has no pipeline parallelism (SURVEY.md §2b: "PP: No");
+round 4 added the GPipe mechanism and this entry, and round 16 replaced
+the plain fill/drain schedule with the real menu (``--pipe_schedule``):
+
+- ``gpipe`` — the round-4 masked fill/drain loop, backward by AD
+  through the schedule (kept as the parity/bench baseline; O(M)
+  activation residency — AD saves every tick's residuals);
+- ``1f1b`` (default) — one-forward-one-backward interleaving
+  (Narayanan et al., SC'21) through the fused slot loop in
+  ``parallel/pipeline.py``: the per-microbatch tail (final LN + tied
+  head + loss) runs on the LAST stage inside the schedule so backward
+  drains while later microbatches still fill, and each stage
+  recomputes its block from the saved boundary activation — O(P)
+  activation residency;
+- ``zb`` — zero-bubble (Qi et al., ICLR'24, ZB-H1-flavoured): backward
+  splits into the critical-path dx pass and deferred dw products
+  computed from stashed (input-activation, output-grad) taps at every
+  linear site — every dw unit drains as ONE batched post-loop wave,
+  the drain region doing the work the bubble used to waste.
 
 Design: the task (not a monolithic flax module) owns the pipeline
 composition —
 
 - embedding / final LayerNorm / tied head are tiny and replicated (the
-  standard PP layout keeps them off the pipeline);
+  standard PP layout keeps them off the pipeline); under 1f1b/zb the
+  final-LN+head *tail* is additionally applied per microbatch on the
+  last stage inside the schedule (same math, microbatch-summed);
 - the block stack is initialised per layer from the shared
   :class:`~.transformer.EncoderBlock`, stacked ``(P, layers_per_stage,
   ...)`` and annotated with the ``pipe_stage`` logical axis, so
   ``parallel.sharding.shard_tree`` places each stage's weights on its
   pipeline rank (a real memory split, like FSDP does over ``data``);
-- the forward reshapes the batch into ``n_micro`` microbatches and runs
-  ``parallel.pipeline.pipeline_apply`` (one SPMD program, activations
-  hopping stage-to-stage over ``lax.ppermute``); AD through the schedule
-  is exact (tests/test_pipeline.py), so the jitted train step needs no
-  pipeline-specific backward.
+- each stage runs its layers as a *stage-local scan* under
+  ``--scan_layers`` (one compiled block body over the
+  ``(layers_per_stage, ...)`` stack) or as an unrolled loop otherwise —
+  the checkpoint layout is identical either way;
+- the zb tap kernel is a hand-rolled twin of the block forward built
+  from the SAME primitives flax lowers to (``_plain_dense``,
+  ``ops.attention.attention``, ``nn.LayerNorm.apply``) — bit-identical
+  outputs, pinned by test — so the deferred dw products are pure
+  einsums over the taps with no second recompute.
 
-Scope note: stages carry no intra-stage TP annotations (compose ``pipe``
-with ``data``; use the non-pipe entries for TP/CP composition).
+Scope note: stages carry no intra-stage TP annotations (compose
+``pipe`` with ``data``; use the non-pipe entries for TP/CP
+composition — ``models/registry.py`` refuses the crosses with intent).
 """
 
 from __future__ import annotations
+
+import functools
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..parallel.pipeline import pipeline_apply
-from ..runtime.context import PIPE_AXIS
+from ..ops.attention import attention
+from ..parallel.pipeline import (
+    PIPE_SCHEDULES,
+    PipeStageKernel,
+    build_pipe_table,
+    pipeline_apply,
+    pipelined_loss,
+    schedule_bubble_fraction,
+)
+from ..runtime.context import DATA_AXIS, PIPE_AXIS
 from ..utils import get_logger
 from .gpt import CausalLmTask
-from .transformer import EncoderBlock, default_kernel_init
+from .transformer import EncoderBlock, _plain_dense, default_kernel_init
 
 log = get_logger(__name__)
 
@@ -48,21 +77,34 @@ log = get_logger(__name__)
 PIPE_STAGE_AXIS = "pipe_stage"
 
 
+@functools.lru_cache(maxsize=64)
+def _cached_table(kind: str, n_micro: int, n_stages: int):
+    return build_pipe_table(kind, n_micro, n_stages)
+
+
 class PipelinedGptTask(CausalLmTask):
-    """Causal-LM task whose block stack executes as a GPipe pipeline.
+    """Causal-LM task whose block stack executes as a pipeline.
 
     Inherits the next-token loss/metrics of :class:`CausalLmTask`; only
-    ``init`` and the forward (``_apply_inputs``) are pipeline-aware.
+    ``init``, the forward (``_apply_inputs``) and — under 1f1b/zb — the
+    training ``loss`` are pipeline-aware.
     """
 
     def __init__(self, mesh: jax.sharding.Mesh, *, vocab_size: int,
                  seq_len: int, num_layers: int, num_heads: int,
                  head_dim: int, mlp_dim: int,
-                 dtype: jnp.dtype = jnp.float32, n_micro: int = 4):
+                 dtype: jnp.dtype = jnp.float32, n_micro: int = 4,
+                 pipe_schedule: str = "1f1b", scan_layers: bool = False):
         # no monolithic flax module: registry knob guards (--remat /
         # --fused_head) see model=None and refuse with intent
         self.model = None
         self.mesh = mesh
+        if pipe_schedule not in PIPE_SCHEDULES:
+            raise ValueError(
+                f"unknown --pipe_schedule {pipe_schedule!r}; expected one "
+                f"of {PIPE_SCHEDULES}")
+        self.pipe_schedule = pipe_schedule
+        self.scan_layers = scan_layers
         # Validation is DEFERRED to first use (init/forward): dataset-only
         # consumers of the registry (tools/make_file_dataset.py,
         # input_bench) build the entry under the default mesh and never
@@ -84,6 +126,7 @@ class PipelinedGptTask(CausalLmTask):
         self.num_heads = num_heads
         self.head_dim = head_dim
         self.embed_dim = num_heads * head_dim
+        self.mlp_dim = mlp_dim
         self.dtype = dtype
         self.n_micro = n_micro
         self._clamp_warned = False
@@ -103,6 +146,62 @@ class PipelinedGptTask(CausalLmTask):
                 "pipe axis of size >= 2 in --mesh (e.g. --mesh data:4,pipe:2 "
                 "on 8 devices)"
             )
+
+    # -- microbatch accounting --------------------------------------------
+    def effective_microbatches(self, batch_size: int) -> int:
+        """The microbatch count a batch of ``batch_size`` examples will
+        actually pipeline with: ``gcd(--pipe_microbatches, per-replica
+        batch)`` — the clamp that keeps every microbatch SPMD-uniform."""
+        from ..parallel.pipeline import effective_pipe_microbatches
+
+        per_replica = batch_size // self.mesh.shape.get(DATA_AXIS, 1)
+        return effective_pipe_microbatches(self.n_micro, per_replica)
+
+    def bubble_fraction(self, batch_size: int) -> float:
+        """Static schedule-model bubble fraction at this geometry."""
+        if self.n_stages is None:
+            return 0.0
+        return schedule_bubble_fraction(
+            self.pipe_schedule, self.effective_microbatches(batch_size),
+            self.n_stages)
+
+    def _microbatch_count(self, b: int) -> int:
+        """Effective count for a concrete batch, with the clamp policy:
+        a clamp to 1 microbatch on a real pipeline is a REFUSAL (the
+        schedule fully serialises — bubble fraction (P-1)/P, every
+        schedule identical), a clamp to fewer-than-requested warns
+        once. Delegates the gcd itself to
+        :meth:`effective_microbatches` — ONE copy of the clamp
+        formula (a batch smaller than the data axis clamps to 1 there
+        and lands in the refusal below, not in an opaque reshape)."""
+        data = self.mesh.shape.get(DATA_AXIS, 1)
+        per_replica = b // data
+        m = self.effective_microbatches(b)
+        if m == 1 and self.n_stages is not None and self.n_stages > 1:
+            raise ValueError(
+                f"pipeline would serialise: gcd(--pipe_microbatches="
+                f"{self.n_micro}, per-replica batch={per_replica}) == 1, "
+                f"so every schedule degenerates to one microbatch with "
+                f"bubble fraction (P-1)/P = "
+                f"{(self.n_stages - 1) / self.n_stages:.2f}. Fix: make "
+                f"the per-replica batch (global batch {b} / data axis "
+                f"{data}) share a factor >= 2 with --pipe_microbatches — "
+                f"e.g. raise --per_device_train_batch_size or set "
+                f"--pipe_microbatches to a divisor of {per_replica}"
+            )
+        if m < self.n_micro and not self._clamp_warned:
+            # a partially-coprime batch/microbatch combination still
+            # shrinks the overlap — say so once, at trace time, instead
+            # of letting the fill/drain bubble grow invisibly
+            self._clamp_warned = True
+            log.warning(
+                "--pipe_microbatches clamped: gcd(n_micro, per-replica "
+                "batch) < requested — the pipeline bubble grows; pick a "
+                "per-replica batch divisible by the microbatch count",
+                {"requested": self.n_micro, "effective": m,
+                 "per_replica_batch": per_replica},
+            )
+        return m
 
     # -- init -------------------------------------------------------------
     def init(self, rng, batch):
@@ -137,55 +236,240 @@ class PipelinedGptTask(CausalLmTask):
         }
         return params, {}
 
-    # -- forward ----------------------------------------------------------
-    def _apply_inputs(self, params, extra_vars, inputs, rng, train):
-        import math
-
-        self._require_pipeline()
-        (ids,) = inputs
-        b, t = ids.shape
-        wte = nn.meta.unbox(params["wte"])
-        wpe = nn.meta.unbox(params["wpe"])
-        x = (wte[ids] + wpe[:t][None]).astype(self.dtype)
-
-        # microbatch count: at most n_micro, constrained so each data
-        # replica's shard divides evenly (pipeline_apply shards the
-        # microbatch dim over ``data`` — real pipe x data composition)
-        from ..runtime.context import DATA_AXIS
-
-        per_replica = b // self.mesh.shape.get(DATA_AXIS, 1)
-        m = math.gcd(self.n_micro, per_replica)
-        if m < self.n_micro and not self._clamp_warned:
-            # a coprime batch/microbatch combination silently serialises
-            # the pipeline (m=1 == no overlap at all) — say so once, at
-            # trace time, instead of letting the fill/drain bubble eat the
-            # speedup invisibly
-            self._clamp_warned = True
-            log.warning(
-                "--pipe_microbatches clamped: gcd(n_micro, per-replica "
-                "batch) < requested — the GPipe fill/drain bubble grows; "
-                "pick a per-replica batch divisible by the microbatch count",
-                {"requested": self.n_micro, "effective": m,
-                 "per_replica_batch": per_replica},
-            )
-        xm = x.reshape(m, b // m, t, self.embed_dim)
-
+    # -- stage kernels -----------------------------------------------------
+    def _stage_fwd(self, stage_params, h):
+        """One pipeline stage = its layers applied in sequence: a
+        stage-local ``lax.scan`` over the ``(layers_per_stage, ...)``
+        stack under ``--scan_layers`` (one compiled block body), an
+        unrolled loop otherwise. Same math, same checkpoint layout."""
         block = self._block
-
-        def stage_fn(stage_params, h):
-            # one pipeline stage = its layers applied in sequence
+        if self.scan_layers:
             def body(carry, layer_params):
                 return block.apply({"params": layer_params}, carry, None,
                                    train=False), None
 
             out, _ = lax.scan(body, h, stage_params)
             return out
+        out = h
+        for i in range(self.layers_per_stage):
+            layer = jax.tree.map(lambda a, i=i: a[i], stage_params)
+            out = block.apply({"params": layer}, out, None, train=False)
+        return out
 
+    def _block_fwd_tapped(self, lp, x, pr):
+        """Tapped twin of ``EncoderBlock`` (pre-LN, causal, dropout 0):
+        identical primitives in identical order (``_plain_dense`` IS
+        DenseGeneral's contraction; ``ops.attention.attention`` is the
+        same dispatch the block uses), plus zero-valued probes added at
+        every linear-site output. The probes' vjp cotangents are the
+        per-site output grads and the returned taps the per-site input
+        activations — together the full input of the deferred dw
+        products."""
+        dt = self.dtype
+        at = lp["attention"]
+        h1f = self._ln.apply({"params": lp["ln_attn"]}, x) + pr["ln_attn"]
+        h1 = h1f.astype(dt)
+        q = _plain_dense(h1, at["query"]["kernel"], at["query"]["bias"],
+                         1, dt) + pr["q"]
+        k = _plain_dense(h1, at["key"]["kernel"], at["key"]["bias"],
+                         1, dt) + pr["k"]
+        v = _plain_dense(h1, at["value"]["kernel"], at["value"]["bias"],
+                         1, dt) + pr["v"]
+        ctx = attention(q, k, v, mask=None, causal=True,
+                        impl=self._block.attn_impl)
+        o = _plain_dense(ctx, at["out"]["kernel"], at["out"]["bias"],
+                         2, dt) + pr["out"]
+        x1 = x + o
+        h2f = self._ln.apply({"params": lp["ln_mlp"]}, x1) + pr["ln_mlp"]
+        h2 = h2f.astype(dt)
+        f1 = _plain_dense(h2, lp["mlp"]["fc1"]["kernel"],
+                          lp["mlp"]["fc1"]["bias"], 1, dt) + pr["fc1"]
+        a1 = nn.gelu(f1)
+        f2 = _plain_dense(a1, lp["mlp"]["fc2"]["kernel"],
+                          lp["mlp"]["fc2"]["bias"], 1, dt) + pr["fc2"]
+        y = x1 + f2
+        taps = {"x": x, "h1": h1, "ctx": ctx, "x1": x1, "h2": h2, "a1": a1}
+        return y, taps
+
+    def _stage_fwd_tapped(self, stage_params, h, probes):
+        """Stage forward with per-layer taps; probes/taps carry a
+        leading ``(layers_per_stage, ...)`` axis (the scan's xs/ys)."""
+        def body(carry, inputs):
+            lp, pr = inputs
+            y, taps = self._block_fwd_tapped(lp, carry, pr)
+            return y, taps
+
+        return lax.scan(body, h, (stage_params, probes))
+
+    def _make_probes(self, stage_params, x_sds):
+        """Zero probes for one microbatch: per layer, one per linear
+        site (LN outputs in f32, dense outputs in the compute dtype)."""
+        mb, t, e = x_sds.shape
+        hk = (mb, t, self.num_heads, self.head_dim)
+        dt = x_sds.dtype
+        one = {
+            "ln_attn": jnp.zeros((mb, t, e), jnp.float32),
+            "q": jnp.zeros(hk, dt),
+            "k": jnp.zeros(hk, dt),
+            "v": jnp.zeros(hk, dt),
+            "out": jnp.zeros((mb, t, e), dt),
+            "ln_mlp": jnp.zeros((mb, t, e), jnp.float32),
+            "fc1": jnp.zeros((mb, t, self.mlp_dim), dt),
+            "fc2": jnp.zeros((mb, t, e), dt),
+        }
+        return jax.tree.map(
+            lambda a: jnp.zeros((self.layers_per_stage, *a.shape),
+                                a.dtype), one)
+
+    def _dw_from_taps(self, stage_params, taps, g_probes):
+        """The deferred weight-grad products: pure einsums over the
+        stashed (input-activation, output-grad) pairs — exactly the
+        terms the fused vjp would have computed, just later. Leaves
+        carry leading ``(slots, layers_per_stage, ...)`` axes; the slot
+        and example axes contract, the layer axis stays."""
+        dt = self.dtype
+        f32 = jnp.float32
+
+        def dense_dw(x, g):  # (S, L, mb, T, in...) x (S, L, mb, T, out...)
+            return jnp.einsum("slbti,slbto->lio", x.astype(dt),
+                              g.astype(dt)).astype(f32)
+
+        def bsum(g):
+            return jnp.sum(g.astype(f32), axis=(0, 2, 3))
+
+        t, g = taps, g_probes
+        gq = jnp.einsum("slbte,slbthk->lehk", t["h1"].astype(dt),
+                        g["q"].astype(dt)).astype(f32)
+        gk = jnp.einsum("slbte,slbthk->lehk", t["h1"].astype(dt),
+                        g["k"].astype(dt)).astype(f32)
+        gv = jnp.einsum("slbte,slbthk->lehk", t["h1"].astype(dt),
+                        g["v"].astype(dt)).astype(f32)
+        gout = jnp.einsum("slbthk,slbte->lhke", t["ctx"].astype(dt),
+                          g["out"].astype(dt)).astype(f32)
+
+        def ln_grads(ln_params, x, gy):
+            # exact LN param grads via a per-(slot, layer) vjp over the
+            # SAME flax apply the forward used — elementwise-cheap
+            def one(pp, xx, gg):
+                _, pull = jax.vjp(
+                    lambda p_: self._ln.apply({"params": p_}, xx), pp)
+                (gp,) = pull(gg)
+                return gp
+
+            over_layers = jax.vmap(one, in_axes=(0, 0, 0))
+            over_slots = jax.vmap(over_layers, in_axes=(None, 0, 0))
+            gp = over_slots(ln_params, x, gy)  # (S, L, ...)
+            return jax.tree.map(lambda a: jnp.sum(a, axis=0), gp)
+
+        return {
+            "attention": {
+                "query": {"kernel": gq, "bias": bsum(g["q"])},
+                "key": {"kernel": gk, "bias": bsum(g["k"])},
+                "value": {"kernel": gv, "bias": bsum(g["v"])},
+                "out": {"kernel": gout, "bias": bsum(g["out"])},
+            },
+            "mlp": {
+                "fc1": {"kernel": dense_dw(t["h2"], g["fc1"]),
+                        "bias": bsum(g["fc1"])},
+                "fc2": {"kernel": dense_dw(t["a1"], g["fc2"]),
+                        "bias": bsum(g["fc2"])},
+            },
+            "ln_attn": ln_grads(stage_params["ln_attn"], t["x"],
+                                g["ln_attn"]),
+            "ln_mlp": ln_grads(stage_params["ln_mlp"], t["x1"],
+                               g["ln_mlp"]),
+        }
+
+    # -- tail (last stage, per microbatch) ---------------------------------
+    def _tail_terms(self, tail_p, y, ids_mb, wt_mb):
+        """Per-microbatch final-LN + tied head + next-token loss sums —
+        the same math ``CausalLmTask.loss`` applies to the whole batch,
+        restricted to one microbatch (sums, not means: the caller's
+        ``weighted_metrics`` supplies the shared denominator)."""
+        h = self._ln.apply({"params": tail_p["final_ln"]},
+                           y.astype(jnp.float32))
+        logits = (h.astype(self.dtype)
+                  @ tail_p["wte"].T.astype(self.dtype)).astype(jnp.float32)
+        targets = ids_mb[:, 1:].astype(jnp.int32)
+        logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+        token_logp = jnp.take_along_axis(
+            logp, targets[..., None], axis=-1)[..., 0]
+        hits = (jnp.argmax(logits[:, :-1], -1) == targets
+                ).astype(jnp.float32)
+        w = wt_mb[:, None]
+        return -(token_logp * w).sum(), (hits * w).sum()
+
+    def _tail_fwd(self, tail_p, y, ids_mb, wt_mb):
+        return self._tail_terms(tail_p, y, ids_mb, wt_mb)
+
+    def _tail_bwd(self, tail_p, y, ids_mb, wt_mb):
+        (loss, hits), pull = jax.vjp(
+            lambda tp, y_: self._tail_terms(tp, y_, ids_mb, wt_mb),
+            tail_p, y)
+        d_tail, gy = pull((jnp.ones((), jnp.float32),
+                           jnp.zeros((), jnp.float32)))
+        return gy.astype(self.dtype), loss, hits, d_tail
+
+    def _kernel(self) -> PipeStageKernel:
+        return PipeStageKernel(
+            fwd=self._stage_fwd,
+            tail_fwd=self._tail_fwd,
+            tail_bwd=self._tail_bwd,
+            fwd_tapped=self._stage_fwd_tapped,
+            make_probes=self._make_probes,
+            dw_from_taps=self._dw_from_taps,
+        )
+
+    # -- forward (gpipe / eval) -------------------------------------------
+    def _embed(self, params, ids):
+        wte = nn.meta.unbox(params["wte"])
+        wpe = nn.meta.unbox(params["wpe"])
+        t = ids.shape[-1]
+        return (wte[ids] + wpe[:t][None]).astype(self.dtype)
+
+    def _apply_inputs(self, params, extra_vars, inputs, rng, train):
+        self._require_pipeline()
+        (ids,) = inputs
+        b, t = ids.shape
+        x = self._embed(params, ids)
+        m = self._microbatch_count(b)
+        xm = x.reshape(m, b // m, t, self.embed_dim)
         blocks = nn.meta.unbox(params["blocks"])
-        out = pipeline_apply(blocks, stage_fn, xm, self.mesh)
+        out = pipeline_apply(blocks, self._stage_fwd, xm, self.mesh)
         out = out.reshape(b, t, self.embed_dim)
         h = self._ln.apply(
             {"params": nn.meta.unbox(params["final_ln"])},
             out.astype(jnp.float32))
+        wte = nn.meta.unbox(params["wte"])
         logits = (h.astype(self.dtype) @ wte.T.astype(self.dtype))
         return logits.astype(jnp.float32), extra_vars, None
+
+    # -- loss --------------------------------------------------------------
+    def loss(self, params, extra_vars, batch, rng, *, train=True):
+        if self.pipe_schedule == "gpipe" or not train:
+            # gpipe: AD through the masked fill/drain loop (the r4
+            # baseline). Eval: the F-only loop + whole-batch tail —
+            # same per-example terms, no backward schedule to fuse.
+            return super().loss(params, extra_vars, batch, rng,
+                                train=train)
+        self._require_pipeline()
+        ids = batch["input_ids"]
+        b, t = ids.shape
+        m = self._microbatch_count(b)
+        x = self._embed(params, ids)
+        xm = x.reshape(m, b // m, t, self.embed_dim)
+        ids_m = jnp.asarray(ids).reshape(m, b // m, t)
+        w = self.example_weights(batch, b)
+        wt_m = w.reshape(m, b // m)
+        table = _cached_table(self.pipe_schedule, m, self.n_stages)
+        tail_p = {
+            "final_ln": nn.meta.unbox(params["final_ln"]),
+            "wte": nn.meta.unbox(params["wte"]),
+        }
+        loss_sum, hits_sum = pipelined_loss(
+            table, self._kernel(), nn.meta.unbox(params["blocks"]),
+            tail_p, xm, ids_m, wt_m, self.mesh)
+        metrics = self.weighted_metrics(
+            w.sum() * (t - 1), train,
+            loss=loss_sum, next_token_accuracy=hits_sum)
+        return metrics["loss"], extra_vars, metrics

@@ -70,23 +70,46 @@ def build(name: str, config: TrainingConfig, mesh=None) -> tuple[Task, Dataset]:
                 f"({type(task.model).__name__}) has no LM head"
             )
         task.model = task.model.clone(fused_head=True)
+    if name.startswith("gpt-pipe"):
+        # the pipelined entries run OUTSIDE the flax-module knob surface
+        # (task.model is None): their schedule composition is validated
+        # here, with pipe-specific reasons, before any tracing
+        for flag, why in (
+            ("tp_overlap", "the ring kernels would have to be traced "
+                           "inside the slot loop's switch branches — a "
+                           "collective inside a divergent-predicate "
+                           "conditional deadlocks on real hardware"),
+            ("fsdp_overlap", "the per-layer weight gathers would have to "
+                             "thread through the slot loop's carry"),
+            ("fsdp", "stage weights already shard over the pipe axis; "
+                     "an additional data split of the stage stack needs "
+                     "gathers the slot schedule does not issue"),
+            ("ddp_overlap", "the per-layer grad reduce would have to "
+                            "drain from inside the slot loop"),
+        ):
+            if getattr(config, flag, False):
+                raise ValueError(
+                    f"--{flag} does not compose with the pipelined "
+                    f"entries ({name!r}) yet: {why}; the pipeline "
+                    "composes with plain data parallelism (pipe×data) "
+                    "only — drop the flag or use a non-pipe entry"
+                )
     if config.scan_layers:
         if name.startswith("gpt-pipe"):
-            # the pipelined entries already stack their blocks per STAGE
-            # over the pipe axis (models/gpt_pipe.py) — a second, per-layer
-            # scan would fight that layout
-            raise ValueError(
-                f"--scan_layers: model {name!r} runs its block stack as a "
-                "GPipe pipeline with its own per-stage weight stacking; "
-                "drop --scan_layers or use a non-pipe entry"
-            )
-        if not hasattr(task.model, "scan_layers"):
-            raise ValueError(
-                f"--scan_layers: model {name!r} "
-                f"({type(task.model).__name__}) has no transformer layer "
-                "stack to scan (transformer families only)"
-            )
-        task.model = task.model.clone(scan_layers=True)
+            # stage-local scan-over-layers: each stage drives ONE block
+            # body over its (layers_per_stage, ...) stack inside the
+            # slot schedule (models/gpt_pipe.py) — the checkpoint layout
+            # (the (P, layers_per_stage, ...) stage stacking) is
+            # identical either way, so no conversion is needed
+            task.scan_layers = True
+        else:
+            if not hasattr(task.model, "scan_layers"):
+                raise ValueError(
+                    f"--scan_layers: model {name!r} "
+                    f"({type(task.model).__name__}) has no transformer "
+                    "layer stack to scan (transformer families only)"
+                )
+            task.model = task.model.clone(scan_layers=True)
     if config.fsdp_overlap:
         if not config.scan_layers:
             raise ValueError(
@@ -467,9 +490,10 @@ def _gpt_moe_tiny(config: TrainingConfig, mesh=None):
 
 @register("gpt-pipe-tiny")
 def _gpt_pipe_tiny(config: TrainingConfig, mesh=None):
-    """Pipeline-parallel causal LM: the block stack runs as a GPipe
-    fill/drain schedule over the ``pipe`` mesh axis through the ordinary
-    Trainer (models/gpt_pipe.py). Launch: ``--model gpt-pipe-tiny --mesh
+    """Pipeline-parallel causal LM: the block stack runs as a pipeline
+    over the ``pipe`` mesh axis through the ordinary Trainer
+    (models/gpt_pipe.py) under the ``--pipe_schedule`` of choice
+    (gpipe | 1f1b | zb). Launch: ``--model gpt-pipe-tiny --mesh
     data:4,pipe:2`` (CPU-CI exercisable)."""
     from ..runtime import make_mesh
     from .gpt_pipe import PipelinedGptTask
@@ -482,7 +506,8 @@ def _gpt_pipe_tiny(config: TrainingConfig, mesh=None):
     task = PipelinedGptTask(mesh, vocab_size=vocab, seq_len=seq_len,
                             num_layers=4, num_heads=4, head_dim=16,
                             mlp_dim=128, dtype=_dtype(config),
-                            n_micro=config.pipe_microbatches)
+                            n_micro=config.pipe_microbatches,
+                            pipe_schedule=config.pipe_schedule)
     return _token_entry(config, task, seq_len, vocab)
 
 

@@ -131,7 +131,8 @@ def cost_of(compiled) -> dict:
 
 
 def static_cost_model(compiled, axis_sizes: dict[str, int] | None = None,
-                      hlo_text: str | None = None) -> dict[str, Any]:
+                      hlo_text: str | None = None,
+                      pipe_bubble_frac: float = 0.0) -> dict[str, Any]:
     """The a-priori per-step budget of one compiled train step.
 
     ``compiled`` is the AOT executable (``jit(...).lower(...).compile()``)
@@ -150,7 +151,12 @@ def static_cost_model(compiled, axis_sizes: dict[str, int] | None = None,
       ``model``; the r11 convention). Axes of size <= 1 contribute zero
       regardless of census text (a single-replica program may still
       contain degenerate collectives);
-    - ``collective_ops`` — the raw per-opcode census (count + bytes).
+    - ``collective_ops`` — the raw per-opcode census (count + bytes);
+    - ``pipe_bubble_frac`` — the pipeline schedule's static bubble
+      fraction (``parallel/pipeline.schedule_bubble_fraction`` at the
+      run's (schedule, M, P); the engine passes it for the pipelined
+      entries). Zeroed when the mesh has no live ``pipe`` axis — the
+      r16 convention mirroring the wire-byte axis gating.
     """
     axis_sizes = dict(axis_sizes or {})
     c = cost_of(compiled)
@@ -166,6 +172,7 @@ def static_cost_model(compiled, axis_sizes: dict[str, int] | None = None,
                     if k in GATHER_FAMILY) if data_live else 0
     wire_model = sum(v["wire_bytes"] for k, v in census.items()
                      if k in RING_FAMILY) if model_live else 0
+    pipe_live = axis_sizes.get("pipe", 1) > 1
     return {
         "flops_per_step": c["flops"],
         "hbm_bytes_per_step": c["bytes"],
@@ -173,6 +180,8 @@ def static_cost_model(compiled, axis_sizes: dict[str, int] | None = None,
         "wire_bytes_model": int(wire_model),
         "wire_bytes_total": int(wire_data + wire_model),
         "collective_ops": census,
+        "pipe_bubble_frac": (float(pipe_bubble_frac) if pipe_live
+                             else 0.0),
     }
 
 
@@ -217,6 +226,9 @@ class PerfAttribution:
             out["peak_tflops"] = round(self.peak_flops / 1e12, 2)
         if self.ici_bytes_per_sec:
             out["ici_gbps"] = round(self.ici_bytes_per_sec / 1e9, 1)
+        if cm.get("pipe_bubble_frac"):
+            out["pipe_bubble_frac_static"] = round(
+                cm["pipe_bubble_frac"], 4)
         return out
 
     def interval(self, *, wall_s: float, steps: int,
@@ -266,6 +278,12 @@ class PerfAttribution:
         out["perf_frac_comm"] = round(frac_device * comm_share, 4)
         out["perf_frac_compute"] = round(
             frac_device - frac_device * comm_share, 4)
+        # pipeline bubble: the static schedule model applied to the
+        # MEASURED device share — an overlay on the compute fraction
+        # (bubble slots are device-occupied-but-idle), never a fifth
+        # term of the sum-to-1.0 quartet. Zero when no pipe axis.
+        bubble = self.cost_model.get("pipe_bubble_frac", 0.0)
+        out["perf_bubble_frac"] = round(frac_device * bubble, 4)
 
         if steps:
             out["perf_step_ms"] = round(1e3 * wall_s / steps, 3)

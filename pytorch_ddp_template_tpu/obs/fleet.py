@@ -80,6 +80,11 @@ FLEET_WIRE_KEYS = (
     #                       a host leaking memory is a straggler-to-be)
     "mem_frac_of_limit",  # that figure over the device limit (0.0
     #                       when unmeasured)
+    # -- r16 pipeline column (appended at the END, same tolerance) --
+    "bubble_frac",        # pipeline-bubble share of this host's wall
+    #                       (the r16 perf_bubble_frac overlay: static
+    #                       schedule model x measured device share;
+    #                       0.0 when no pipe axis or no --perf_report)
 )
 
 #: signals the fleet table summarises with min/median/max (step is an

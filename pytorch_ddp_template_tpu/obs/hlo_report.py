@@ -223,22 +223,7 @@ def composed_evidence(hlo_text: str) -> dict[str, Any]:
     # map each computation to the computations it references (while
     # bodies, calls, fusions) so a gather body "contains" the ring
     # bodies its nested loops execute
-    refs: dict[str, set[str]] = {}
-    cur: str | None = None
-    ref_re = re.compile(
-        r"(?:body|condition|to_apply|calls|branch_computations)="
-        r"[{(]?%?([\w.\-]+)")
-    for line in hlo_text.splitlines():
-        stripped = line.strip()
-        if stripped.endswith("{") and "(" in stripped and "->" in stripped:
-            cur = norm(stripped.split(" ", 1)[0])
-            refs[cur] = set()
-            continue
-        if stripped.startswith("}"):
-            cur = None
-            continue
-        if cur is not None:
-            refs[cur].update(ref_re.findall(stripped))
+    refs = _computation_refs(hlo_text)
 
     def reaches_ring(name: str, seen: set[str]) -> bool:
         if name in ring_ind:
@@ -259,6 +244,147 @@ def composed_evidence(hlo_text: str) -> dict[str, Any]:
         "independent_ring_bodies": len(ring_ind),
         "bodies_with_both_independent": both,
         "composed_overlap_independent": len(both) >= 1,
+    }
+
+
+def _computation_refs(hlo_text: str) -> dict[str, set[str]]:
+    """computation -> computations it references (while bodies, calls,
+    fusions, conditional branches) — the nested-reachability map the
+    composed and pipe walkers share.
+
+    Two passes: collect every computation name first, then count any
+    ``%name`` token matching one as a reference — a keyed regex alone
+    misses all-but-the-first entry of
+    ``branch_computations={%a, %b, ...}`` lists (the slot-loop switch
+    lowers to exactly that shape)."""
+    names: set[str] = set()
+    for line in hlo_text.splitlines():
+        stripped = line.strip()
+        if stripped.endswith("{") and "(" in stripped and "->" in stripped:
+            names.add(stripped.split(" ", 1)[0].lstrip("%"))
+    refs: dict[str, set[str]] = {}
+    cur: str | None = None
+    for line in hlo_text.splitlines():
+        stripped = line.strip()
+        if stripped.endswith("{") and "(" in stripped and "->" in stripped:
+            cur = stripped.split(" ", 1)[0].lstrip("%")
+            refs[cur] = set()
+            continue
+        if stripped.startswith("}"):
+            cur = None
+            continue
+        if cur is not None:
+            for tok in _TOKEN_RE.findall(stripped):
+                name = tok.lstrip("%")
+                if name != cur and name in names:
+                    refs[cur].add(name)
+    return refs
+
+
+def pipe_evidence(hlo_text: str) -> dict[str, Any]:
+    """Schedulability witness for the pipeline slot loop (r16).
+
+    The fused 1F1B/ZB driver issues its two boundary ppermutes at the
+    top of every slot, consuming only loop-carried send buffers — so in
+    the lowered slot-loop body every ``collective-permute``'s operand
+    chain must reach only loop state, never this slot's compute. The
+    slot WORK lives inside ``conditional`` branches (the work switch),
+    so a body counts as a *slot body* when it carries ppermutes and
+    reaches dot ops through its referenced computations (nested
+    conditionals/fusions), not necessarily directly.
+
+    Returns: ``slot_bodies`` (ppermute-carrying, dot-reaching loop
+    bodies), ``independent_send_bodies`` (all of whose ppermutes are
+    compute-independent), the headline ``pipe_sends_independent``,
+    ``conditional_count`` (the work-switch witness) and
+    ``dw_ops_present`` — whether the zb deferred-dw computations are in
+    the program (via the ``pipe_stage_dw``/``pipe_dw_wave`` named
+    scopes the driver stamps; scope metadata survives into the compiled
+    dump on this toolchain — absent metadata degrades this to False,
+    never a crash).
+    """
+    # dots per computation (direct) + the nested-reachability map
+    refs = _computation_refs(hlo_text)
+    comps = parse_computations(hlo_text)
+    direct_dots: dict[str, bool] = {}
+    for name, instrs in comps:
+        direct_dots[name.lstrip("%")] = any(
+            " dot(" in s or " convolution(" in s for s in instrs)
+
+    def reaches_dots(name: str, seen: set[str]) -> bool:
+        if direct_dots.get(name):
+            return True
+        if name in seen:
+            return False
+        seen.add(name)
+        return any(reaches_dots(r, seen) for r in refs.get(name, ()))
+
+    rows = []
+    for name, instrs in comps:
+        cname = name.lstrip("%")
+        if cname.upper().startswith("ENTRY"):
+            # entry holds the region-edge output permute (the dx slice
+            # leaving the shard_map), not a slot-schedule witness
+            continue
+        defs: dict[str, tuple[list[str], str]] = {}
+        for s in instrs:
+            lhs, _, rhs = s.partition("=")
+            names_ = _TOKEN_RE.findall(lhs)
+            if names_:
+                defs[names_[0]] = (_TOKEN_RE.findall(rhs), s)
+
+        def is_work(instr: str) -> bool:
+            # "compute" the sends must not depend on: a same-body dot,
+            # OR any instruction executing a dot-reaching nested
+            # computation (the slot switch's conditional, fusions) —
+            # without the nested case the fused loops, whose dots live
+            # entirely inside the switch branches, could never trip
+            # the send-independence check
+            if " dot(" in instr or " convolution(" in instr:
+                return True
+            return any(tok.lstrip("%") in direct_dots
+                       and reaches_dots(tok.lstrip("%"), set())
+                       for tok in _TOKEN_RE.findall(
+                           instr.partition("=")[2])
+                       if tok.lstrip("%") in refs)
+        work_names = {n for n, (_, s) in defs.items() if is_work(s)}
+        pp_names = [n for n, (_, s) in defs.items()
+                    if " collective-permute(" in s
+                    or " collective-permute-start(" in s]
+        if not pp_names or not reaches_dots(cname, set()):
+            continue
+
+        dep_cache: dict[str, bool] = {}
+
+        def depends_on_work(n: str) -> bool:
+            if n in dep_cache:
+                return dep_cache[n]
+            dep_cache[n] = False
+            if n in work_names:
+                dep_cache[n] = True
+                return True
+            ops = defs.get(n, ([], ""))[0]
+            dep_cache[n] = any(depends_on_work(o) for o in ops)
+            return dep_cache[n]
+
+        independent = all(
+            not any(depends_on_work(o) for o in defs[n][0])
+            for n in pp_names)
+        rows.append({"computation": cname, "ppermutes": len(pp_names),
+                     "sends_independent": independent})
+    independent_bodies = [r for r in rows if r["sends_independent"]]
+    conditional_count = sum(
+        1 for _, instrs in comps
+        for s in instrs if " conditional(" in s)
+    return {
+        "bodies": rows,
+        "slot_bodies": len(rows),
+        "independent_send_bodies": len(independent_bodies),
+        "pipe_sends_independent": bool(rows) and (
+            len(independent_bodies) == len(rows)),
+        "conditional_count": conditional_count,
+        "dw_ops_present": ("pipe_stage_dw" in hlo_text
+                           or "pipe_dw_wave" in hlo_text),
     }
 
 
@@ -361,6 +487,7 @@ def schedule_report(hlo_text: str) -> dict[str, Any]:
             "composed_overlap_independent":
                 composed["composed_overlap_independent"],
         },
+        "pipe": pipe_evidence(hlo_text),
     }
 
 
@@ -418,5 +545,34 @@ def check_overlap_expectations(report: dict[str, Any], config: Any,
                 "compute-independent gather-family collectives and "
                 "independent ring ppermutes — the two axes' overlap "
                 "pipelines are no longer composed in one body"
+            )
+    # r16 pipe check: a pipelined entry's stage-boundary hops must be
+    # compute-independent in the loop body (issued before the consuming
+    # compute), and under zb the deferred-dw computations must actually
+    # be in the program (their absence means the split backward has
+    # silently degraded to the fused one)
+    pipe_axis = axis_sizes.get("pipe", 1)
+    pipe_model = str(getattr(config, "model", "")).startswith("gpt-pipe")
+    if pipe_model and pipe_axis > 1:
+        pe = report.get("pipe", {})
+        sched = getattr(config, "pipe_schedule", "gpipe")
+        if not pe.get("pipe_sends_independent", False):
+            warns.append(
+                f"pipe schedule {sched!r} is active but the slot loop's "
+                "stage-boundary collective-permutes are not compute-"
+                "independent (or no slot body was found): the p2p hops "
+                "cannot start under the adjacent microbatch's work — "
+                "the pipeline schedule has degraded to "
+                "send-then-compute "
+                f"(slot_bodies={pe.get('slot_bodies', 0)}, "
+                f"independent={pe.get('independent_send_bodies', 0)})"
+            )
+        if sched == "zb" and not pe.get("dw_ops_present", False):
+            warns.append(
+                "pipe_schedule=zb is active but no deferred-dw "
+                "computation (pipe_stage_dx / pipe_dw_wave named scope) "
+                "appears in the compiled program: the dx/dw split has "
+                "not survived compilation — the deferred dw wave that "
+                "fills the drain region is missing"
             )
     return warns

@@ -1,29 +1,69 @@
-"""Minimal pipeline parallelism over the ``pipe`` mesh axis.
+"""Pipeline parallelism over the ``pipe`` mesh axis: GPipe, 1F1B and
+zero-bubble schedules for *homogeneous* stages.
 
-The reference has no pipeline parallelism (SURVEY.md §2b: "PP: No"), and
-``PIPE_AXIS`` existed only as a name — this module gives the axis a real
-mechanism so the mesh surface stays honest (VERDICT.md round-3 weak #7):
-a GPipe-style fill/drain schedule for *homogeneous* stages, expressed the
-TPU-native way — one SPMD program under ``shard_map``, microbatch
-activations flowing stage-to-stage over ``lax.ppermute`` (ICI
-neighbour hops on hardware), the schedule a ``lax.fori_loop`` over
-``M + P - 1`` ticks with masked inactivity in the bubbles.
+Round 4 gave ``PIPE_AXIS`` its first mechanism — the GPipe fill/drain
+loop (:func:`pipeline_apply`): one SPMD program under ``shard_map``,
+microbatch activations hopping stage-to-stage over single-hop
+``lax.ppermute`` (ICI neighbour hops on hardware), reverse-mode AD
+through the loop supplying the backward. Its two structural costs are
+textbook: the fill/drain bubble wastes ``(P-1)/(M+P-1)`` of the
+schedule twice (forward and backward), and AD through the tick loop
+saves every tick's residuals — O(M) activation residency per stage.
 
-Scope (deliberate): equal-shaped stages (the transformer layer-stack
-case), no 1F1B interleaving — a mechanism proof sized to the capability
-envelope, not a Megatron replacement. It *is* trainable: the fill/drain
-loop has a static trip count, so JAX rewrites the ``fori_loop`` to a
-``scan`` at trace time (a While loop proper would not be reverse-mode
-differentiable) and AD flows through the ``ppermute`` hops — ``jax.grad``
-through ``pipeline_apply`` matches sequential-stage gradients to float32
-tolerance (tests/test_pipeline.py). ``stage_params`` carries a stacked leading stage
-axis sharded over ``pipe``, which is exactly how a layer-stacked
-``lax.scan`` transformer would shard its weights for PP.
+This round adds the two schedules that fix them, driven from explicit
+**slot tables** (:func:`build_pipe_table`, host-side numpy — the same
+tick/slot maps the Megatron-LM and zero-bubble papers draw):
+
+- **1F1B** (Narayanan et al., SC'21): forward and backward interleave
+  in ONE slot loop — each slot a stage runs exactly one unit of work
+  (``lax.switch`` over {F, B, idle}; only the selected branch
+  executes), with the per-microbatch loss computed on the last stage
+  inside the schedule so backward can start while later microbatches
+  are still filling. Backward recomputes each stage from its saved
+  boundary activation (the r8-r11 recompute-from-boundary convention),
+  so activation residency drops to the in-flight count — O(P), pinned
+  by the live-range bench leg.
+- **ZB** (Qi et al., ICLR'24, ZB-H1-flavoured): backward splits into
+  the activation-grad pass **dx** (stays on the critical path — it is
+  what unblocks the upstream stage; the zb slot loop carries only
+  {F, BDX}, so its steady slots are cheaper than 1F1B's fused-B ones)
+  and the weight-grad pass **dw** (no cross-stage consumer, so it is
+  deferred wholesale: the dx pass stashes its taps per microbatch and
+  the dw units drain *after* the loop as ONE batched wave over them —
+  the drain region, doing exactly the work the bubble used to waste).
+  The split shares one recompute: the dx pass
+  captures each linear site's input activation and output gradient
+  (the ZB paper's stashed (x, g) pairs, implemented as primal taps +
+  zero-valued output probes whose cotangents ARE the output grads),
+  and the dw wave is then pure products — no second recompute.
+
+Schedule-owned state (send buffers, activation/grad/tap stores, grad
+accumulators) rides the slot loop's carry; the two boundary ppermutes
+are issued at the TOP of every slot, before the consuming compute, so
+the p2p hops hide under the adjacent microbatch's work exactly the way
+TP hides its ring ppermutes (compute-independent in the lowered body —
+the ``--hlo_report`` pipe tripwire checks this).
+
+Gradients are computed **in the primal pass** of a ``custom_vjp``
+(:func:`pipelined_loss`): 1F1B/ZB interleave B into the forward
+schedule, so by the time the loss scalar exists every gradient does
+too; the vjp rule just scales the stashed grads by the incoming loss
+cotangent. The undifferentiated path (eval) runs the cheap F-only
+GPipe loop instead.
+
+``stage_params`` carries a stacked leading stage axis sharded over
+``pipe`` — each rank holds only its own stage — and when the mesh also
+has a live ``data`` axis the microbatch dim shards over it (pipe×data
+composition with real DP speedup).
 """
 
 from __future__ import annotations
 
+import dataclasses
+import math
 from typing import Any, Callable
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -32,6 +72,37 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..runtime.context import DATA_AXIS, PIPE_AXIS
 from .stacking import check_leading_axis, stack_params
+
+#: the user-facing schedule names (--pipe_schedule)
+PIPE_SCHEDULES = ("gpipe", "1f1b", "zb")
+
+#: slot work ids (the table's vocabulary). B is 1F1B's fused backward
+#: (dx+dw in one unit); BDX/BDW are ZB's split halves.
+WORK_IDLE, WORK_F, WORK_B, WORK_BDX, WORK_BDW = 0, 1, 2, 3, 4
+
+#: relative slot costs in forward-units for the makespan/bubble model:
+#: a block backward is ~2x its forward; recompute-from-boundary adds 1F
+#: to whichever pass recomputes. 1F1B's fused B = recompute + dx + dw;
+#: ZB's dx pass = recompute + dx (the dw products are deferred), its dw
+#: pass = the products alone.
+WORK_COSTS = {
+    WORK_IDLE: 0.0,
+    WORK_F: 1.0,
+    WORK_B: 3.0,
+    WORK_BDX: 2.0,
+    WORK_BDW: 1.0,
+}
+
+
+def effective_pipe_microbatches(requested: int, per_replica: int) -> int:
+    """THE microbatch gcd clamp — the single copy both the task
+    (``models/gpt_pipe.effective_microbatches``) and the startup
+    telemetry (``parallel/sharding.describe``) use, so the logged
+    figure can never drift from the schedule's: ``gcd(requested,
+    per-replica batch)``, with a batch smaller than one example per
+    replica clamping to 1 (which the task then REFUSES — full
+    serialisation)."""
+    return math.gcd(max(int(requested), 1), max(int(per_replica), 1))
 
 
 def stack_stage_params(per_stage: list[Any], mesh: Mesh) -> Any:
@@ -122,3 +193,567 @@ def pipeline_apply(
     # (P, M, mb, ...): every rank banked a buffer; only the last stage's
     # holds the pipeline output
     return out[-1]
+
+
+# -- slot tables ------------------------------------------------------------
+
+@dataclasses.dataclass
+class PipeTable:
+    """A compiled-schedule description: per (slot, stage) the work unit,
+    microbatch index and store-slot assignments, plus the two arrival
+    maps (which microbatch's activation/grad lands on the wire at each
+    slot and which store slot it belongs in). Host-side numpy — the
+    driver ships each row into the scanned loop as static data."""
+
+    kind: str
+    n_micro: int
+    n_stages: int
+    work: np.ndarray       # (T, P) work ids
+    mb: np.ndarray         # (T, P) microbatch index (0 when idle)
+    aslot: np.ndarray      # (T, P) activation-store slot for F/B/BDX
+    gslot: np.ndarray      # (T, P) incoming-grad store slot for B/BDX
+    arr_f_mb: np.ndarray   # (T, P) mb arriving on the fwd wire (-1 none)
+    arr_f_slot: np.ndarray
+    arr_g_mb: np.ndarray   # (T, P) mb arriving on the bwd wire (-1 none)
+    arr_g_slot: np.ndarray
+    n_aslots: int          # activation residency (the 1F1B O(P) story)
+    n_gslots: int
+    wave_units_per_stage: int  # zb: deferred dw units each stage drains
+    #                            in the batched post-loop wave (= M)
+
+    @property
+    def n_slots(self) -> int:
+        return int(self.work.shape[0])
+
+    @property
+    def wave_count(self) -> int:
+        return self.wave_units_per_stage * self.n_stages
+
+    def pretty(self) -> str:
+        names = {WORK_IDLE: ".", WORK_F: "F", WORK_B: "B",
+                 WORK_BDX: "X", WORK_BDW: "W"}
+        lines = []
+        for p in range(self.n_stages):
+            row = ["." if self.work[t, p] == WORK_IDLE
+                   else f"{names[int(self.work[t, p])]}{self.mb[t, p]}"
+                   for t in range(self.n_slots)]
+            lines.append(f"s{p}: " + " ".join(f"{c:>3}" for c in row))
+        return "\n".join(lines)
+
+
+def _stage_sequences(kind: str, n_micro: int, n_stages: int):
+    """Per-stage ordered work skeletons — the classic 1F1B shape: stage
+    ``p`` warms up with ``min(M, P-1-p)`` forwards, then strictly
+    alternates F/B, then drains its remaining backwards. ZB uses the
+    same skeleton with B -> BDX (the dw halves are scheduled
+    separately, into bubbles and the post-loop wave)."""
+    M, P = n_micro, n_stages
+    bk = WORK_B if kind == "1f1b" else WORK_BDX
+    seqs = []
+    for p in range(P):
+        w = min(M, P - 1 - p)
+        seq = [(WORK_F, i) for i in range(w)]
+        for i in range(w, M):
+            seq.append((WORK_F, i))
+            seq.append((bk, i - w))
+        for i in range(M - w, M):
+            seq.append((bk, i))
+        seqs.append(seq)
+    return seqs
+
+
+def build_pipe_table(kind: str, n_micro: int, n_stages: int) -> PipeTable:
+    """Build + verify the slot table for ``kind`` in {"1f1b", "zb"}.
+
+    Slot semantics: at the top of every slot each stage forwards its
+    send buffers one hop (fwd activations down, bwd grads up), then
+    executes at most ONE work unit. A unit produced at slot t is
+    consumable downstream from slot t+1 (it lands in the consumer's
+    store via the arrival maps, decoupling production cadence from
+    consumption). ZB's slot loop carries only {F, BDX} — the dx chain
+    IS the critical path — and every deferred dw unit drains in the
+    post-loop wave (``wave_units_per_stage``), one batched product
+    over the taps the dx pass emitted. (An earlier in-loop-dw variant
+    threaded the tap store through the slot loop's carry/switch; on
+    this host that threading cost more than the deferred products
+    saved — the wave consumes the taps as write-once scan outputs
+    instead.)
+    """
+    if kind not in ("1f1b", "zb"):
+        raise ValueError(f"build_pipe_table: unknown schedule {kind!r}; "
+                         "expected '1f1b' or 'zb' (gpipe has no slot "
+                         "table — it is the masked fill/drain loop)")
+    if n_micro < 1 or n_stages < 2:
+        raise ValueError(
+            f"build_pipe_table needs n_micro >= 1 and n_stages >= 2, got "
+            f"M={n_micro}, P={n_stages}")
+    M, P = n_micro, n_stages
+    seqs = _stage_sequences(kind, M, P)
+    ptr = [0] * P
+    f_slot = np.full((P, M), -1, dtype=np.int64)
+    b_slot = np.full((P, M), -1, dtype=np.int64)
+    w_pending: list[list[int]] = [[] for _ in range(P)]
+
+    rows_work, rows_mb = [], []
+    t = 0
+    while any(ptr[p] < len(seqs[p]) for p in range(P)):
+        if t > 4 * (M + P) * (P + 2) + 16:  # defensive: never trip expected
+            raise RuntimeError("pipe schedule did not converge")
+        work_row, mb_row = [WORK_IDLE] * P, [0] * P
+        for p in range(P):
+            kindw, i = (seqs[p][ptr[p]] if ptr[p] < len(seqs[p])
+                        else (WORK_IDLE, 0))
+            ready = False
+            if kindw == WORK_F:
+                ready = p == 0 or 0 <= f_slot[p - 1, i] < t
+            elif kindw in (WORK_B, WORK_BDX):
+                ready = (0 <= f_slot[p, i] < t) and (
+                    p == P - 1 or 0 <= b_slot[p + 1, i] < t)
+            if ready:
+                work_row[p], mb_row[p] = kindw, i
+                ptr[p] += 1
+                if kindw == WORK_F:
+                    f_slot[p, i] = t
+                else:
+                    b_slot[p, i] = t
+                    if kind == "zb":
+                        w_pending[p].append(i)
+        rows_work.append(work_row)
+        rows_mb.append(mb_row)
+        t += 1
+
+    T = len(rows_work)
+    work = np.array(rows_work, dtype=np.int32)
+    mb = np.array(rows_mb, dtype=np.int32)
+
+    arr_f_mb = np.full((T, P), -1, dtype=np.int32)
+    arr_g_mb = np.full((T, P), -1, dtype=np.int32)
+    for p in range(P):
+        for i in range(M):
+            if p + 1 < P:
+                arr_f_mb[f_slot[p, i] + 1, p + 1] = i
+            if p - 1 >= 0 and b_slot[p, i] + 1 < T:
+                arr_g_mb[b_slot[p, i] + 1, p - 1] = i
+
+    def alloc(intervals_per_stage):
+        """Greedy interval packing per stage; SPMD-uniform slot count."""
+        slots_map: dict[tuple[int, int], int] = {}
+        n_total = 0
+        for p, intervals in enumerate(intervals_per_stage):
+            free: list[int] = []
+            busy: list[tuple[int, int]] = []
+            n_here = 0
+            for start, end, key in sorted(intervals):
+                busy.sort()
+                while busy and busy[0][0] < start:
+                    free.append(busy.pop(0)[1])
+                if free:
+                    s = min(free)
+                    free.remove(s)
+                else:
+                    s, n_here = n_here, n_here + 1
+                slots_map[key] = s
+                busy.append((end, s))
+            n_total = max(n_total, n_here)
+        return slots_map, max(n_total, 1)
+
+    a_ints = [[(f_slot[p, i] if p == 0 else f_slot[p - 1, i] + 1,
+                b_slot[p, i], (p, i)) for i in range(M)]
+              for p in range(P)]
+    a_map, n_aslots = alloc(a_ints)
+    g_ints = [[(b_slot[p + 1, i] + 1, b_slot[p, i], (p, i))
+               for i in range(M)] if p < P - 1 else []
+              for p in range(P)]
+    g_map, n_gslots = alloc(g_ints)
+    aslot = np.zeros((T, P), dtype=np.int32)
+    gslot = np.zeros((T, P), dtype=np.int32)
+    arr_f_slot = np.zeros((T, P), dtype=np.int32)
+    arr_g_slot = np.zeros((T, P), dtype=np.int32)
+    for tt in range(T):
+        for p in range(P):
+            i = int(mb[tt, p])
+            w = int(work[tt, p])
+            if w in (WORK_F, WORK_B, WORK_BDX):
+                aslot[tt, p] = a_map[(p, i)]
+            if w in (WORK_B, WORK_BDX) and p < P - 1:
+                gslot[tt, p] = g_map[(p, i)]
+            if arr_f_mb[tt, p] >= 0:
+                arr_f_slot[tt, p] = a_map[(p, int(arr_f_mb[tt, p]))]
+            if arr_g_mb[tt, p] >= 0:
+                arr_g_slot[tt, p] = g_map[(p, int(arr_g_mb[tt, p]))]
+
+    tab = PipeTable(kind, M, P, work, mb, aslot, gslot,
+                    arr_f_mb, arr_f_slot, arr_g_mb, arr_g_slot,
+                    n_aslots, n_gslots,
+                    wave_units_per_stage=M if kind == "zb" else 0)
+    _verify_table(tab, f_slot, b_slot)
+    return tab
+
+
+def _verify_table(tab: PipeTable, f_slot, b_slot) -> None:
+    """Structural invariants — every unit exactly once, dependencies
+    strictly ordered (zb's dw units all live in the wave)."""
+    M, P = tab.n_micro, tab.n_stages
+    for p in range(P):
+        for i in range(M):
+            assert 0 <= f_slot[p, i] < b_slot[p, i]
+            if p > 0:
+                assert f_slot[p - 1, i] < f_slot[p, i]
+            if p < P - 1:
+                assert b_slot[p + 1, i] < b_slot[p, i]
+    counts: dict[tuple[int, int, int], int] = {}
+    for t in range(tab.n_slots):
+        for p in range(P):
+            w = int(tab.work[t, p])
+            if w != WORK_IDLE:
+                key = (p, int(tab.mb[t, p]), w)
+                counts[key] = counts.get(key, 0) + 1
+    assert all(c == 1 for c in counts.values())
+
+
+def schedule_makespan(kind: str, n_micro: int, n_stages: int,
+                      costs: dict[int, float] | None = None
+                      ) -> tuple[float, float]:
+    """``(span, useful)`` of one schedule at (M, P) under the lockstep
+    makespan model: each slot lasts as long as its most expensive
+    branch across stages (a stage that finished early waits at the
+    next slot's boundary ppermute); the zb dw wave extends the span by
+    one stage's wave, running concurrently on every stage. Units are
+    whatever ``costs`` is in (:data:`WORK_COSTS` forward-units by
+    default; the bench legs pass measured per-branch times, making
+    this the "static schedule model + measured device time" figure the
+    r13 attribution convention asks for). GPipe's loop is masked, not
+    slotted — its span is the closed form ``(M+P-1)`` fwd + bwd passes
+    with every tick costing the full unit (masked ticks execute)."""
+    M, P = n_micro, n_stages
+    costs = {**WORK_COSTS, **(costs or {})}
+    if kind == "gpipe":
+        span = (M + P - 1) * (costs[WORK_F] + costs[WORK_B])
+        useful = M * P * (costs[WORK_F] + costs[WORK_B])
+        return span, useful
+    tab = build_pipe_table(kind, M, P)
+    span = sum(max(costs[int(w)] for w in row) for row in tab.work)
+    useful = sum(costs[int(w)] for row in tab.work for w in row)
+    if tab.wave_units_per_stage:
+        span += tab.wave_units_per_stage * costs[WORK_BDW]
+        useful += tab.wave_count * costs[WORK_BDW]
+    return span, useful
+
+
+def schedule_bubble_fraction(kind: str, n_micro: int, n_stages: int,
+                             costs: dict[int, float] | None = None
+                             ) -> float:
+    """Static bubble fraction at (M, P): ``1 - useful / (P * span)``
+    over the :func:`schedule_makespan` model. For gpipe this reduces
+    to the textbook ``(P-1)/(M+P-1)`` (both passes bubble
+    identically, so the fraction is pass-independent)."""
+    M, P = n_micro, n_stages
+    if P <= 1 or M < 1:
+        return 0.0
+    span, useful = schedule_makespan(kind, M, P, costs)
+    return max(0.0, 1.0 - useful / (P * span))
+
+
+# -- the fused 1F1B / ZB driver ---------------------------------------------
+
+@dataclasses.dataclass
+class PipeStageKernel:
+    """The task's per-stage callbacks the fused schedules drive.
+
+    All functions are pure; shapes are per-microbatch (``mb``-leading).
+
+    - ``fwd(stage_w, x) -> y`` — one stage forward.
+    - ``tail_fwd(tail_p, y, tgt, wt) -> (loss, hits)`` — the last
+      stage's per-microbatch tail (final norm + head + loss sums).
+    - ``tail_bwd(tail_p, y, tgt, wt) -> (gy, loss, hits, d_tail)`` —
+      the tail's value-and-grad (seeds the backward).
+    - ``fwd_tapped(stage_w, x, probes) -> (y, taps)`` (zb) — forward
+      with zero-valued ``probes`` added at every linear-site output
+      (their vjp cotangents ARE the per-site output grads) and the
+      per-site input activations returned as ``taps``.
+    - ``make_probes(stage_w, x_sds) -> probes`` (zb) — zero probes for
+      a microbatch of shape/dtype ``x_sds``.
+    - ``dw_from_taps(stage_w, taps, g_probes) -> gw`` (zb) — the
+      deferred weight-grad products. Leaves of ``taps``/``g_probes``
+      carry an extra LEADING axis which the implementation contracts:
+      the post-loop wave feeds it the whole per-microbatch tap store
+      (one entry per microbatch) in one batched product.
+    """
+
+    fwd: Callable
+    tail_fwd: Callable
+    tail_bwd: Callable
+    fwd_tapped: Callable | None = None
+    make_probes: Callable | None = None
+    dw_from_taps: Callable | None = None
+
+
+def _dyn(row, p):
+    return lax.dynamic_index_in_dim(row, p, keepdims=False)
+
+
+def _store_read(store, slot):
+    return lax.dynamic_index_in_dim(store, slot, keepdims=False)
+
+
+def _store_write(store, slot, value, pred):
+    """Write ``value`` into ``store[slot]`` when ``pred`` — the no-write
+    case rewrites the current slot contents (one slot of traffic, never
+    the whole store)."""
+    cur = _store_read(store, slot)
+    return lax.dynamic_update_index_in_dim(
+        store, jnp.where(pred, value, cur), slot, axis=0)
+
+
+def pipelined_loss(table: PipeTable, kernel: PipeStageKernel,
+                   stage_params: Any, tail_params: Any,
+                   x_feed: jax.Array, tgt: jax.Array, wt: jax.Array,
+                   mesh: Mesh) -> tuple[jax.Array, jax.Array]:
+    """Pipelined per-microbatch loss under ``table``'s fused schedule.
+
+    Returns ``(loss_sum, hits_sum)`` — the per-microbatch tail sums
+    accumulated across the schedule (psum'd over ``pipe`` and ``data``).
+
+    Differentiation contract: the schedule interleaves backward into
+    the forward pass, so under ``jax.grad`` the primal pass already
+    produces every gradient; they ride the custom_vjp residuals and the
+    backward rule scales them by the incoming loss cotangent. ``tgt``
+    and ``wt`` are data, not parameters — their cotangents are symbolic
+    zeros (the decomposed-scan extras convention).
+
+    Without differentiation (eval) the cheap F-only fill/drain loop
+    runs instead (:func:`pipeline_apply` + the per-microbatch tail),
+    summing in the same per-microbatch order — the two paths agree.
+    """
+    M, Pn = table.n_micro, table.n_stages
+    kind = table.kind
+    n_stages = mesh.shape[PIPE_AXIS]
+    if n_stages != Pn:
+        raise ValueError(
+            f"pipelined_loss: table built for {Pn} stages but the mesh "
+            f"pipe axis has {n_stages}")
+    check_leading_axis(stage_params, Pn, "pipe axis")
+    data_size = mesh.shape.get(DATA_AXIS, 1)
+    if data_size > 1 and x_feed.shape[1] % data_size:
+        raise ValueError(
+            f"pipeline microbatch size {x_feed.shape[1]} not divisible "
+            f"by the data axis size {data_size}; adjust batch size or "
+            "the microbatch count")
+    if kind == "zb" and (kernel.fwd_tapped is None
+                         or kernel.dw_from_taps is None
+                         or kernel.make_probes is None):
+        raise ValueError("pipe_schedule=zb needs the tapped stage kernel "
+                         "(fwd_tapped / make_probes / dw_from_taps)")
+
+    rows = tuple(jnp.asarray(a) for a in
+                 (table.work, table.mb, table.aslot,
+                  table.gslot, table.arr_f_mb, table.arr_f_slot,
+                  table.arr_g_mb, table.arr_g_slot))
+    fwd_perm = [(i, (i + 1) % Pn) for i in range(Pn)]
+    bwd_perm = [(i, (i - 1) % Pn) for i in range(Pn)]
+    psum_axes = (PIPE_AXIS, DATA_AXIS) if data_size > 1 else (PIPE_AXIS,)
+
+    from .shard_map_compat import shard_map
+
+    def per_device(stage_w, tail_p, x_local, tgt_local, wt_local):
+        stage_w = jax.tree.map(lambda a: a[0], stage_w)
+        p = lax.axis_index(PIPE_AXIS)
+        last = p == Pn - 1
+        mb_shape = x_local.shape[1:]
+        dt = x_local.dtype
+
+        if kind == "zb":
+            probe0 = kernel.make_probes(
+                stage_w, jax.ShapeDtypeStruct(mb_shape, dt))
+            _, tap0 = jax.eval_shape(
+                lambda x_, pr: kernel.fwd_tapped(stage_w, x_, pr),
+                jax.ShapeDtypeStruct(mb_shape, dt), probe0)
+            tap_pair0 = (tap0, probe0)
+        else:
+            tap_pair0 = ((), ())
+
+        carry = {
+            "y_send": jnp.zeros(mb_shape, dt),
+            "g_send": jnp.zeros(mb_shape, dt),
+            "acts": jnp.zeros((table.n_aslots, *mb_shape), dt),
+            "gys": jnp.zeros((table.n_gslots, *mb_shape), dt),
+            "dw": jax.tree.map(jnp.zeros_like, stage_w),
+            "d_tail": jax.tree.map(jnp.zeros_like, tail_p),
+            "dx": jnp.zeros((M, *mb_shape), dt),
+            "loss": jnp.zeros((), jnp.float32),
+            "hits": jnp.zeros((), jnp.float32),
+            # zb: per-microbatch tap store (slot i = microbatch i; every
+            # tap survives to the post-loop wave, so no slot reuse)
+            "taps": jax.tree.map(
+                lambda a: jnp.zeros((M, *a.shape), a.dtype), tap_pair0),
+        }
+
+        def zero_tail():
+            return jax.tree.map(jnp.zeros_like, tail_p)
+
+        def deltas(y=None, g=None, gw=None, taps=None, dl=None, dh=None,
+                   dtail=None):
+            """Uniform switch-branch output: only small per-slot values
+            plus the (mostly-zero) accumulator adds — the big stores
+            stay OUT of the switch so branches never copy them."""
+            return (
+                y if y is not None else jnp.zeros(mb_shape, dt),
+                g if g is not None else jnp.zeros(mb_shape, dt),
+                gw if gw is not None else jax.tree.map(
+                    jnp.zeros_like, stage_w),
+                taps if taps is not None else jax.tree.map(
+                    lambda a: jnp.zeros(a.shape, a.dtype), tap_pair0),
+                dl if dl is not None else jnp.zeros((), jnp.float32),
+                dh if dh is not None else jnp.zeros((), jnp.float32),
+                dtail if dtail is not None else zero_tail(),
+            )
+
+        def slot(c, xs):
+            work, mbi, asl, gsl, afm, afs, agm, ags = [
+                _dyn(r, p) for r in xs]
+            # boundary hops FIRST, consuming last slot's send buffers:
+            # dataflow-independent of this slot's compute by
+            # construction, so the latency-hiding scheduler may run the
+            # p2p under the adjacent microbatch's work
+            with jax.named_scope("pipe_send"):
+                recv_y = lax.ppermute(c["y_send"], PIPE_AXIS, fwd_perm)
+                recv_g = lax.ppermute(c["g_send"], PIPE_AXIS, bwd_perm)
+            acts = _store_write(c["acts"], afs, recv_y, afm >= 0)
+            gys = _store_write(c["gys"], ags, recv_g, agm >= 0)
+            mbc = jnp.clip(mbi, 0, M - 1)
+
+            def boundary_x():
+                return jnp.where(p == 0, x_local[mbc],
+                                 _store_read(acts, asl))
+
+            def tail_or_recv(y):
+                def w_tail(_):
+                    return kernel.tail_bwd(tail_p, y, tgt_local[mbc],
+                                           wt_local[mbc])
+
+                def wo_tail(_):
+                    return (_store_read(gys, gsl).astype(dt),
+                            jnp.zeros((), jnp.float32),
+                            jnp.zeros((), jnp.float32), zero_tail())
+
+                return lax.cond(last, w_tail, wo_tail, None)
+
+            def br_idle():
+                return deltas()
+
+            def br_f():
+                with jax.named_scope("pipe_stage_fwd"):
+                    y = kernel.fwd(stage_w, boundary_x())
+                return deltas(y=y)
+
+            def br_b():  # 1f1b: fused backward, recompute from boundary
+                x = boundary_x()
+                with jax.named_scope("pipe_stage_bwd"):
+                    y, pull = jax.vjp(
+                        lambda w_, x_: kernel.fwd(w_, x_), stage_w, x)
+                    gy, dl, dh, dtail = tail_or_recv(y)
+                    gw, gx = pull(gy)
+                return deltas(g=gx, gw=gw, dl=dl, dh=dh, dtail=dtail)
+
+            def br_bdx():  # zb: dx only; (x, g) taps stashed for dw
+                x = boundary_x()
+                pr0 = jax.tree.map(
+                    lambda a: jnp.zeros(a.shape, a.dtype), probe0)
+                with jax.named_scope("pipe_stage_dx"):
+                    (y, taps), pull = jax.vjp(
+                        lambda x_, pr: kernel.fwd_tapped(stage_w, x_, pr),
+                        x, pr0)
+                    gy, dl, dh, dtail = tail_or_recv(y)
+                    gx, g_probes = pull(
+                        (gy, jax.tree.map(jnp.zeros_like, taps)))
+                return deltas(g=gx, taps=(taps, g_probes), dl=dl, dh=dh,
+                              dtail=dtail)
+
+            if kind == "zb":
+                branches = [br_idle, br_f, br_idle, br_bdx]
+            else:
+                branches = [br_idle, br_f, br_b, br_idle]
+            y_new, g_new, gw_add, tap_new, dl, dh, dtail_add = lax.switch(
+                work, branches)
+
+            is_f = work == WORK_F
+            is_b = (work == WORK_B) | (work == WORK_BDX)
+            c2 = dict(c)
+            c2["acts"] = _store_write(acts, asl, boundary_x(), is_f)
+            c2["gys"] = gys
+            c2["y_send"] = jnp.where(is_f, y_new, c["y_send"])
+            c2["g_send"] = jnp.where(is_b, g_new, c["g_send"])
+            c2["dw"] = jax.tree.map(jnp.add, c["dw"], gw_add)
+            c2["d_tail"] = jax.tree.map(jnp.add, c["d_tail"], dtail_add)
+            c2["dx"] = _store_write(c["dx"], mbc, g_new, is_b & (p == 0))
+            c2["loss"] = c["loss"] + dl
+            c2["hits"] = c["hits"] + dh
+            if kind == "zb":
+                c2["taps"] = jax.tree.map(
+                    lambda s, v: _store_write(s, mbc, v,
+                                              work == WORK_BDX),
+                    c["taps"], tap_new)
+            return c2, None
+
+        c, _ = lax.scan(slot, carry, rows)
+        dw = c["dw"]
+        if kind == "zb" and table.wave_units_per_stage:
+            # the post-loop dw wave: ONE batched product over every
+            # microbatch's stashed taps (leading axis = microbatch; the
+            # dx chain was the critical path, this is the deferred
+            # remainder — the drain region, doing the work the bubble
+            # used to waste)
+            with jax.named_scope("pipe_dw_wave"):
+                gw = kernel.dw_from_taps(stage_w, c["taps"][0],
+                                         c["taps"][1])
+            dw = jax.tree.map(jnp.add, dw, gw)
+        loss = lax.psum(c["loss"], psum_axes)
+        hits = lax.psum(c["hits"], psum_axes)
+        if data_size > 1:
+            dw = jax.tree.map(lambda a: lax.psum(a, DATA_AXIS), dw)
+        d_tail = jax.tree.map(lambda a: lax.psum(a, psum_axes),
+                              c["d_tail"])
+        return (loss, hits, jax.tree.map(lambda a: a[None], dw), d_tail,
+                c["dx"][None])
+
+    batch_spec = P(None, DATA_AXIS) if data_size > 1 else P()
+    pspec = jax.tree.map(
+        lambda a: P(PIPE_AXIS, *([None] * (a.ndim - 1))), stage_params)
+    tspec = jax.tree.map(lambda a: P(), tail_params)
+    dx_spec = (P(PIPE_AXIS, None, DATA_AXIS) if data_size > 1
+               else P(PIPE_AXIS))
+    region = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(pspec, tspec, batch_spec, batch_spec, batch_spec),
+        out_specs=(P(), P(), pspec, tspec, dx_spec),
+        check_vma=False,
+    )
+
+    from .overlap import _zero_cotangent
+
+    @jax.custom_vjp
+    def run(stage_w, tail_p, x, tgt, wt):
+        # undifferentiated path: the cheap F-only fill/drain loop + the
+        # per-microbatch tail, summed in schedule order
+        ys = pipeline_apply(stage_w, kernel.fwd, x, mesh)
+        loss = jnp.zeros((), jnp.float32)
+        hits = jnp.zeros((), jnp.float32)
+        for i in range(M):
+            li, hi = kernel.tail_fwd(tail_p, ys[i], tgt[i], wt[i])
+            loss, hits = loss + li, hits + hi
+        return loss, hits
+
+    def run_fwd(stage_w, tail_p, x, tgt, wt):
+        loss, hits, dw, d_tail, dx = region(stage_w, tail_p, x, tgt, wt)
+        return (loss, hits), (dw, d_tail, dx[0], tgt, wt)
+
+    def run_bwd(res, cts):
+        dw, d_tail, dx, tgt, wt = res
+        gl, _ = cts  # hits is an argmax count: gradient zero a.e.
+        scale = lambda t: jax.tree.map(
+            lambda a: (a * gl).astype(a.dtype), t)
+        return (scale(dw), scale(d_tail), scale(dx),
+                _zero_cotangent(tgt), _zero_cotangent(wt))
+
+    run.defvjp(run_fwd, run_bwd)
+    return run(stage_params, tail_params, x_feed, tgt, wt)

@@ -59,7 +59,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..runtime.context import DATA_AXIS, MODEL_AXIS
+from ..runtime.context import DATA_AXIS, MODEL_AXIS, PIPE_AXIS
 
 #: module paths inside one encoder block -> logical axis names, mirroring
 #: the ``nn.with_logical_partitioning`` annotations in
@@ -149,15 +149,21 @@ def spec_mentions(spec: P | None, axis: str) -> bool:
 # -- unified mesh validation -----------------------------------------------
 
 def validate_schedule_mesh(mesh: Mesh | None, *, fsdp: bool = False,
-                           ddp: bool = False, tp: bool = False) -> Mesh:
-    """Refuse meshes the composed decomposed-scan cannot serve, with the
-    reason named per axis.
+                           ddp: bool = False, tp: bool = False,
+                           pipe: bool = False) -> Mesh:
+    """Refuse meshes the composed decomposed schedules cannot serve,
+    with the reason named per axis.
 
-    The composable set is ``data`` (fsdp gathers / ddp reduces) ×
-    ``model`` (tp rings). ``seq``/``pipe``/``expert`` axes need in-region
-    handling no schedule implements; a live ``model`` axis WITHOUT a tp
-    schedule means the weights are model-sharded but the fsdp/ddp region
-    specs would silently unshard them.
+    The composable sets: ``data`` (fsdp gathers / ddp reduces) ×
+    ``model`` (tp rings) for the decomposed-scan family, and ``pipe`` ×
+    ``data`` for the pipeline slot schedules (``pipe=True`` — the r16
+    fourth contribution, :class:`PipelineSchedule`). ``seq``/``expert``
+    axes need in-region handling no schedule implements. The crosses
+    that are refused stay refused with the reason named: pipe×tp would
+    need the ring kernels traced inside the slot branches (per-shard
+    geometry inside a conditional), and pipe×fsdp/ddp would need the
+    gather/reduce drains threaded through the slot loop's carry — both
+    real designs, neither implemented yet.
     """
     if mesh is None:
         raise ValueError(
@@ -165,6 +171,36 @@ def validate_schedule_mesh(mesh: Mesh | None, *, fsdp: bool = False,
             "threaded into the model (models/registry.py does this; pass "
             "mesh= when building directly)"
         )
+    if pipe:
+        if fsdp or ddp or tp:
+            other = "/".join(n for n, on in (
+                ("fsdp", fsdp), ("ddp", ddp), ("tp", tp)) if on)
+            raise ValueError(
+                f"the pipeline slot schedules compose with the data axis "
+                f"only; {other} decomposition inside a pipelined stage "
+                "would need its collectives issued from within the slot "
+                "loop's switch branches (a collective inside a "
+                "divergent-predicate conditional deadlocks on real "
+                "hardware) — drop the overlap flags or use a non-pipe "
+                "entry"
+            )
+        if mesh.shape.get(PIPE_AXIS, 1) <= 1:
+            raise ValueError(
+                "the pipeline schedules drive a 'pipe' mesh axis of size "
+                f">= 2, but the mesh is {dict(mesh.shape)} — add pipe:N "
+                "to --mesh"
+            )
+        extra = {name: size for name, size in mesh.shape.items()
+                 if name not in (DATA_AXIS, PIPE_AXIS) and size > 1}
+        if extra:
+            raise ValueError(
+                f"the pipeline schedules compose over pipe×data only; "
+                f"mesh also has {extra} — pipe×{'/'.join(extra)} needs "
+                "in-slot handling no schedule implements yet (tp rings "
+                "or fsdp gathers inside the slot branches); drop the "
+                "extra axes"
+            )
+        return mesh
     allowed = {DATA_AXIS} | ({MODEL_AXIS} if tp else set())
     extra = {name: size for name, size in mesh.shape.items()
              if name not in allowed and size > 1}
@@ -540,6 +576,68 @@ class DdpSchedule:
     def finalize(self, gacc, ys):
         gws, res = ys
         return gws, res
+
+
+class PipelineSchedule:
+    """Pipeline contribution (the r16 fourth schedule axis): owns the
+    slot table, the boundary-ppermute send/recv state and the dx/dw
+    split policy for a ``pipe`` mesh axis.
+
+    Unlike the three scan contributions above, the pipeline does not
+    iterate over *layers* — it iterates over schedule *slots*, with the
+    per-stage layer scan nested INSIDE each slot's work unit (the
+    stage-local ``--scan_layers``). Its driver is therefore
+    ``parallel/pipeline.pipelined_loss`` (one fused slot loop whose
+    carry holds the schedule-owned state: send buffers, activation/
+    grad/tap stores, grad accumulators) rather than
+    :func:`decomposed_scan`; what it shares with the other three is the
+    framework surface — this class plugs the pipe axis into
+    :func:`validate_schedule_mesh`, ``describe()``'s unified overlap
+    block and the ``--hlo_report`` tripwire
+    (``obs/hlo_report.check_overlap_expectations``).
+
+    Composition today: pipe×data (the microbatch dim shards over
+    ``data`` inside the same region). pipe×tp and pipe×fsdp/ddp are
+    refused with the reason named — see :func:`validate_schedule_mesh`.
+    """
+
+    def __init__(self, mesh: Mesh, kind: str, n_micro: int):
+        from .pipeline import PIPE_SCHEDULES, build_pipe_table
+
+        if kind not in PIPE_SCHEDULES:
+            raise ValueError(
+                f"unknown pipe schedule {kind!r}; expected one of "
+                f"{PIPE_SCHEDULES}")
+        validate_schedule_mesh(mesh, pipe=True)
+        self.mesh = mesh
+        self.kind = kind
+        self.n_micro = n_micro
+        self.n_stages = mesh.shape[PIPE_AXIS]
+        # gpipe is the masked fill/drain loop — no slot table
+        self.table = (None if kind == "gpipe"
+                      else build_pipe_table(kind, n_micro, self.n_stages))
+
+    def bubble_fraction(self) -> float:
+        from .pipeline import schedule_bubble_fraction
+
+        return schedule_bubble_fraction(self.kind, self.n_micro,
+                                        self.n_stages)
+
+    def wire_bytes_per_step(self, mb: int, seq: int, embed: int,
+                            itemsize: int = 4) -> int:
+        """Boundary-activation bytes one training step moves over the
+        pipe axis (the r9 ``grad_wire_mb`` convention applied to PP),
+        counted as single-hop buffer sends of ``(mb, seq, embed)`` per
+        stage: the fused slot loops issue TWO ppermutes per slot (fwd
+        activation down + bwd grad up), gpipe's masked loop ONE per
+        tick (fwd ticks send activations; the AD-transposed backward
+        ticks send grads)."""
+        buf = mb * seq * embed * itemsize
+        if self.table is not None:
+            hops = 2 * self.table.n_slots
+        else:
+            hops = 2 * (self.n_micro + self.n_stages - 1)
+        return hops * self.n_stages * buf
 
 
 # -- composed-schedule HLO evidence ----------------------------------------

@@ -259,6 +259,52 @@ def describe(mesh: Mesh, config: Any = None,
                 out["tp_wire_mb_head"] = round(wires["head"] / 1e6, 3)
                 out["tp_wire_mb_per_step"] = round(
                     (wires["stack"] + wires["head"]) / 1e6, 3)
+        pipe_size = sizes.get(PIPE_AXIS, 1)
+        if (pipe_size > 1
+                and str(getattr(config, "model", "")
+                        ).startswith("gpt-pipe")):
+            # r16 pipeline block: which schedule, how many microbatches
+            # actually pipeline (the gcd clamp made visible), the
+            # schedule model's bubble fraction at that geometry, and the
+            # boundary-activation wire budget (r9 grad_wire convention)
+            from .pipeline import (
+                effective_pipe_microbatches, schedule_bubble_fraction,
+            )
+
+            sched = getattr(config, "pipe_schedule", "gpipe")
+            requested = int(getattr(config, "pipe_microbatches", 1))
+            data_size = sizes.get(DATA_AXIS, 1)
+            # per-replica batch = train_batch_size / data = the
+            # per-device figure; the clamp is THE shared helper, so
+            # this logged value tracks the task's schedule exactly
+            per_replica = max(
+                getattr(config, "per_device_train_batch_size", 1), 1)
+            eff = effective_pipe_microbatches(requested, per_replica)
+            out["pipe_mode"] = sched
+            out["pipe_stages"] = pipe_size
+            out["pipe_microbatches"] = requested
+            out["effective_microbatches"] = eff
+            out["pipe_bubble_frac_static"] = round(
+                schedule_bubble_fraction(sched, max(eff, 1), pipe_size), 4)
+            if params is not None:
+                wpe = nn.meta.unbox(params).get("wpe")
+                if wpe is not None and getattr(wpe, "ndim", 0) == 2:
+                    # best-effort like every other describe() figure: a
+                    # mesh PipelineSchedule refuses (extra axes the task
+                    # itself tolerates) must not crash the startup log
+                    try:
+                        from .schedule import PipelineSchedule
+
+                        seq, embed = int(wpe.shape[0]), int(wpe.shape[1])
+                        mb = max(per_replica // max(eff, 1), 1)
+                        wire = PipelineSchedule(
+                            mesh, sched, max(eff, 1)).wire_bytes_per_step(
+                                mb, seq, embed,
+                                itemsize=2 if getattr(config, "bf16",
+                                                      False) else 4)
+                        out["pipe_wire_mb_per_step"] = round(wire / 1e6, 3)
+                    except Exception:  # noqa: BLE001 - logging only
+                        pass
         if getattr(config, "fsdp", False):
             out["fsdp_mode"] = ("decomposed-prefetch"
                                 if getattr(config, "fsdp_overlap", False)
@@ -314,14 +360,23 @@ def describe(mesh: Mesh, config: Any = None,
             modes["ddp"] = out["ddp_mode"]
         if "tp_mode" in out:
             modes["tp"] = out["tp_mode"]
+        if "pipe_mode" in out:
+            modes["pipe"] = out["pipe_mode"]
         if modes:
+            # "decomposed" = an explicitly-scheduled axis: the three
+            # scan contributions, plus the pipeline's fused slot
+            # schedules (gpipe's masked loop is the baseline, like
+            # gspmd-default is for the others)
             decomposed = [k for k, v in modes.items()
-                          if v not in (None, "gspmd-default", "zero1")]
+                          if v not in (None, "gspmd-default", "zero1",
+                                       "gpipe")]
             wire_parts = {}
             if "grad_wire_mb_per_step" in out:
                 wire_parts["grad_mb"] = out["grad_wire_mb_per_step"]
             if "tp_wire_mb_per_step" in out:
                 wire_parts["tp_mb"] = out["tp_wire_mb_per_step"]
+            if "pipe_wire_mb_per_step" in out:
+                wire_parts["pipe_mb"] = out["pipe_wire_mb_per_step"]
             out["overlap"] = {
                 "schedule": modes,
                 "decomposed_axes": decomposed,

@@ -181,3 +181,147 @@ def detect_layer_layout(tree: Any, axis_name: str = LAYER_AXIS) -> str:
         if kind in found:
             return kind
     return "none"
+
+
+# -- pipelined stage-stack layout conversion (r16) -----------------------
+
+#: subtree key under which the pipelined entries stack their block
+#: weights ``(n_stages, layers_per_stage, ...)`` (models/gpt_pipe.py) —
+#: params AND their optimizer-state mirrors carry the same key
+PIPE_STACK_KEY = "blocks"
+
+
+def _map_pipe_stacks(tree: Any, fn) -> Any:
+    """Apply ``fn`` to every raw pipelined ``blocks`` subtree (params
+    and optimizer mirrors alike; layer-form blocks and everything else
+    pass through). ``fn`` receives the whole subtree."""
+    if isinstance(tree, dict):
+        return {
+            k: (fn(v) if k == PIPE_STACK_KEY and not _is_layer_form(v)
+                else _map_pipe_stacks(v, fn))
+            for k, v in tree.items()
+        }
+    if isinstance(tree, (list, tuple)):
+        return _rebuild(tree, [_map_pipe_stacks(v, fn) for v in tree])
+    return tree
+
+
+def _is_layer_form(v: Any) -> bool:
+    """A blocks subtree already in one of the r7 layer layouts (the
+    scanned ``{"layers": ...}`` dict or unrolled ``layer_{i}`` dicts) —
+    as opposed to the raw pipelined ``(P, layers_per_stage, ...)``
+    module tree."""
+    return isinstance(v, dict) and (
+        set(v) == {LAYER_AXIS} or _layer_dict_size(v) is not None)
+
+
+def detect_pipe_stages(tree: Any) -> int | None:
+    """Leading stage-axis size of the raw pipelined ``blocks`` subtrees,
+    or None when the tree has none (a non-pipelined checkpoint, or one
+    already converted to a layer layout). Mixed sizes refuse: they
+    would mean a corrupt or hand-edited state."""
+    sizes: set[int] = set()
+
+    def walk(t):
+        if isinstance(t, dict):
+            for k, v in t.items():
+                if k == PIPE_STACK_KEY and not _is_layer_form(v):
+                    for leaf in jax.tree.leaves(v):
+                        if getattr(leaf, "ndim", 0) >= 2:
+                            sizes.add(int(leaf.shape[0]))
+                else:
+                    walk(v)
+        elif isinstance(t, (list, tuple)):
+            for v in t:
+                walk(v)
+
+    walk(tree)
+    if len(sizes) > 1:
+        raise ValueError(
+            f"pipelined stage stacks disagree on the stage count "
+            f"{sorted(sizes)} — refusing a corrupt state tree")
+    return sizes.pop() if sizes else None
+
+
+def repipe_stage_trees(tree: Any, n_stages_to: int) -> Any:
+    """Restack every ``(P, layers_per_stage, ...)`` blocks subtree to
+    ``n_stages_to`` stages — the reshape is lossless and involutive
+    (layer order is row-major in both layouts, so stage boundaries move
+    without reordering layers). Refuses a layer count the new stage
+    count does not divide."""
+    p_from = detect_pipe_stages(tree)
+    if p_from is None:
+        raise ValueError(
+            "state holds no pipelined stage stack (no 'blocks' subtree "
+            "with a leading stage axis) — nothing to repipe; pipelined "
+            "layouts come from the gpt-pipe entries")
+
+    def leaf(a):
+        if getattr(a, "ndim", 0) < 2:
+            return a
+        total = a.shape[0] * a.shape[1]
+        if total % n_stages_to:
+            raise ValueError(
+                f"cannot restack {total} layers onto {n_stages_to} "
+                f"stages: {total} % {n_stages_to} != 0 — pick a stage "
+                "count that divides the layer count")
+        return a.reshape(n_stages_to, total // n_stages_to, *a.shape[2:])
+
+    return _map_pipe_stacks(tree, lambda v: jax.tree.map(leaf, v))
+
+
+def pipe_to_layer_stack(tree: Any) -> Any:
+    """Pipelined → scanned: each raw blocks subtree's ``(P,
+    layers_per_stage, ...)`` leading dims merge into one ``(num_layers,
+    ...)`` stacked layer dim spelled in the r7 scanned layout
+    (``{"layers": ...}``) — so ``detect_layer_layout`` recognises the
+    result and ``unroll_layer_trees`` takes it the rest of the way to
+    the unrolled form. Per-layer order preserved (row-major), bit-exact
+    and involutive with :func:`layer_stack_to_pipe`."""
+    if detect_pipe_stages(tree) is None:
+        raise ValueError(
+            "state holds no pipelined stage stack (no 'blocks' subtree "
+            "with a leading stage axis) — nothing to convert")
+    return _map_pipe_stacks(
+        tree, lambda v: {LAYER_AXIS: jax.tree.map(
+            lambda a: (a.reshape(a.shape[0] * a.shape[1], *a.shape[2:])
+                       if getattr(a, "ndim", 0) >= 2 else a), v)})
+
+
+def layer_stack_to_pipe(tree: Any, n_stages: int) -> Any:
+    """Scanned → pipelined: split each blocks subtree's ``{"layers":
+    (num_layers, ...)}`` stack into the raw ``(n_stages,
+    layers_per_stage, ...)`` stage stacking."""
+    found = [False]
+
+    def leaf(a):
+        if getattr(a, "ndim", 0) < 1:
+            return a
+        if a.shape[0] % n_stages:
+            raise ValueError(
+                f"cannot split {a.shape[0]} layers onto {n_stages} "
+                f"stages: {a.shape[0]} % {n_stages} != 0")
+        return a.reshape(n_stages, a.shape[0] // n_stages, *a.shape[1:])
+
+    def convert(v):
+        if isinstance(v, dict) and set(v) == {LAYER_AXIS}:
+            found[0] = True
+            return jax.tree.map(leaf, v[LAYER_AXIS])
+        return v
+
+    def walk(t):
+        if isinstance(t, dict):
+            return {k: (convert(v) if k == PIPE_STACK_KEY else walk(v))
+                    for k, v in t.items()}
+        if isinstance(t, (list, tuple)):
+            return _rebuild(t, [walk(v) for v in t])
+        return t
+
+    out = walk(tree)
+    if not found[0]:
+        raise ValueError(
+            "state holds no scanned 'blocks' layer stack to split into "
+            "stages (expected blocks = {\"layers\": stacked} — convert "
+            "unrolled checkpoints to the scanned layout first, or pass "
+            "a pipelined one directly)")
+    return out

@@ -1289,6 +1289,11 @@ class Trainer:
             "gp_wall_s": sum(gp.values()) - sum(mark.values()),
             "anomaly": 1.0 if (self.sentry is not None
                                and self.sentry.triggered) else 0.0,
+            # r16: pipeline-bubble share of the wall (0.0 without
+            # --perf_report or a pipe axis) — a fleet whose bubble
+            # fractions diverge has a desynchronised pipeline
+            "bubble_frac": self._last_perf_rec.get(
+                "perf_bubble_frac", 0.0),
         }
         if self.memory is not None:
             # the r15 memory columns (zero-filled by encode_window when
@@ -1534,8 +1539,20 @@ class Trainer:
         the device's peak-rate table (``--peak_tflops`` overrides)."""
         from ..obs.attribution import PerfAttribution, static_cost_model
 
+        # r16: pipelined entries contribute their schedule's static
+        # bubble fraction (task.bubble_fraction; zero when no pipe axis
+        # or no pipelined task) so the runtime attribution can overlay
+        # perf_bubble_frac on the measured device share
+        pipe_bubble = 0.0
+        bf = getattr(self.task, "bubble_fraction", None)
+        if callable(bf):
+            try:
+                pipe_bubble = float(bf(self.config.train_batch_size))
+            except Exception:  # noqa: BLE001 - attribution only
+                pipe_bubble = 0.0
         cost_model = static_cost_model(
-            compiled, dict(self.ctx.mesh.shape), hlo_text=hlo_text)
+            compiled, dict(self.ctx.mesh.shape), hlo_text=hlo_text,
+            pipe_bubble_frac=pipe_bubble)
         devices = self.ctx.mesh.devices
         self.perf = PerfAttribution(
             cost_model,

@@ -295,13 +295,13 @@ class TestNewConfigSurface:
 
 
 class TestPipeMicrobatchClampWarning:
-    def test_coprime_clamp_warns_once(self, tmp_path, monkeypatch):
+    def test_serialising_clamp_refuses(self, tmp_path, monkeypatch):
         """gcd clamp below --pipe_microbatches must be loud: a coprime
         batch/microbatch combination silently serialises the pipeline
-        (round-5 advisor finding)."""
-        from pytorch_ddp_template_tpu.models import gpt_pipe
+        (round-5 advisor finding; r16 escalated the fully-serialising
+        case from a one-shot warning to a named refusal — partial
+        clamps still warn, tests/test_pipeline.py)."""
         from pytorch_ddp_template_tpu.runtime import make_mesh
-        from pytorch_ddp_template_tpu.runtime.context import RuntimeContext
 
         cfg = TrainingConfig(
             model="gpt-pipe-tiny", mesh="data:4,pipe:2",
@@ -311,23 +311,13 @@ class TestPipeMicrobatchClampWarning:
         mesh = make_mesh(cfg.mesh, jax.devices())
         task, _ = build(cfg.model, cfg, mesh=mesh)
 
-        warnings = []
-        monkeypatch.setattr(
-            gpt_pipe.log, "warning",
-            lambda msg, *a, **k: warnings.append((msg, a)))
-
         import flax.linen as nn
 
-        # batch of 2 over data:4... per-replica shard < n_micro and
-        # coprime: 2 rows over 4 data shards is invalid, use 4 rows →
-        # per_replica 1, gcd(4,1)=1 → full serialisation, must warn
+        # 4 rows over data:4 → per_replica 1, gcd(4,1)=1 → the pipeline
+        # would fully serialise: a refusal naming both fixes
         ids = np.asarray(
             np.random.default_rng(0).integers(0, 1024, (4, 128)), np.int32)
         params, _ = task.init(jax.random.PRNGKey(0), {"input_ids": ids})
-        task._apply_inputs(nn.meta.unbox(params), {},
-                           (jnp.asarray(ids),), None, False)
-        assert len(warnings) == 1, warnings
-        # warn once, not per trace
-        task._apply_inputs(nn.meta.unbox(params), {},
-                           (jnp.asarray(ids),), None, False)
-        assert len(warnings) == 1
+        with pytest.raises(ValueError, match="serialise"):
+            task._apply_inputs(nn.meta.unbox(params), {},
+                               (jnp.asarray(ids),), None, False)

@@ -655,3 +655,97 @@ def test_comms_record_committed_and_affirmative():
     assert last["param_dist_int8_ef"] < last["param_dist_int8_no_ef"]
     # neutrality-or-better on the recorded pair (0.9 band -> vs_baseline)
     assert last["vs_baseline"] >= 1.0
+
+
+@pytest.mark.slow
+def test_pipe_mode_contract():
+    """BENCH_MODE=pipe: one JSON line carrying the round-16 pipeline
+    legs — schedule parity vs sequential stages, the FLOPs-matched
+    gpipe/1f1b/zb step-ratio pair, bubble fractions from the static
+    model and from measured branch times, the slot-loop HLO evidence
+    and the gpipe-vs-1f1b live-range comparison (slow: ~7 fused-loss
+    compiles in a subprocess; the committed record in
+    bench_records/pipe_cpu_r16.jsonl is the tier-1-visible evidence)."""
+    code, lines, out = run_bench({
+        "BENCH_MODE": "pipe", "BENCH_CPU_DEVICES": "8",
+        "BENCH_PIPE": "2", "BENCH_MICRO": "2", "BENCH_MICRO_MEM": "4",
+        "BENCH_SEQ": "32", "BENCH_BATCH": "4", "BENCH_STEPS": "2",
+        "BENCH_WARMUP": "1",
+    }, timeout=1800)
+    assert code == 0, out[-2000:]
+    assert len(lines) == 1, out[-2000:]
+    row = lines[0]
+    assert REQUIRED <= set(row)
+    assert row["value"] > 0
+    assert row["degenerate"] is False
+    assert max(row["parity_max_rel_grad"].values()) < 5e-3
+    assert row["bubble_frac"]["zb"]["static"] < \
+        row["bubble_frac"]["1f1b"]["static"]
+    # the measured ordering is a recorded leg, not an assert: branch
+    # timings on a loaded host can jitter (the COMMITTED record pins it)
+    assert "bubble_measured_ordering_ok" in row
+    assert row["hlo_pipe"]["1f1b"]["pipe_sends_independent"] is True
+    assert row["hlo_pipe"]["zb"]["dw_ops_present"] is True
+
+
+def test_pipe_mode_degenerate_without_devices():
+    """Fewer than 4 devices cannot carve a pipe×data mesh: the mode
+    must emit the labelled degenerate record, not a fake ratio."""
+    code, lines, out = run_bench({
+        "BENCH_MODE": "pipe", "BENCH_CPU_DEVICES": "1",
+    }, timeout=240)
+    assert code == 0, out[-2000:]
+    row = lines[-1]
+    assert row["degenerate"] is True
+    assert row["value"] == 0.0
+
+
+def test_pipe_record_committed_and_affirmative():
+    """The committed round-16 CPU record must exist and actually show
+    the evidence the round claims: grad parity across all three
+    schedules within the float32 conventions, the FLOPs-matched 1f1b
+    step ratio inside the 0.9 band and zb at-or-above 1f1b's band, the
+    measured bubble fraction for zb strictly below 1f1b's, the
+    slot-loop ppermutes compute-independent with zb's deferred-dw ops
+    present, and the 1f1b-vs-gpipe live-range gap (O(P) vs O(M)
+    activation residency)."""
+    import json
+    from pathlib import Path
+
+    path = Path(__file__).resolve().parent.parent / "bench_records" / \
+        "pipe_cpu_r16.jsonl"
+    assert path.is_file(), "run BENCH_MODE=pipe to record the legs"
+    records = [json.loads(l) for l in path.read_text().splitlines() if l]
+    assert records
+    last = records[-1]
+    assert last["metric"].startswith("pipe_step_ratio_1f1b")
+    assert last["degenerate"] is False
+    # FLOPs-matched step ratios: 1f1b within the 0.9 band of gpipe on
+    # WALL time; zb >= 1f1b in the lockstep schedule model at MEASURED
+    # branch times (this 1-core host time-slices the 8 virtual
+    # devices, so its wall clock tracks total work and charges zb the
+    # tap-deferral traffic while giving it no bubble to fill — the
+    # wall ratio is recorded and labelled, the real-chip triplet rides
+    # tools/tpu_followup.sh legs_r16)
+    assert last["value"] >= 0.9
+    assert last["vs_baseline"] >= 1.0
+    assert last["ratio_zb_vs_1f1b_modeled"] >= 1.0
+    assert 0.5 <= last["ratio_zb_vs_1f1b_wall"]  # recorded, labelled
+    assert "wall_caveat" in last
+    # parity: every schedule reproduces sequential-stage autodiff
+    assert max(last["parity_max_rel_grad"].values()) < 5e-3
+    # the zero-bubble claim, on the static model AND with measured
+    # branch times: zb's bubble strictly below 1f1b's
+    bf = last["bubble_frac"]
+    assert bf["zb"]["static"] < bf["1f1b"]["static"]
+    assert bf["zb"]["measured"] < bf["1f1b"]["measured"]
+    assert last["bubble_measured_ordering_ok"] is True
+    # slot-loop schedulability witness + the dx/dw split's presence
+    for kind in ("1f1b", "zb"):
+        assert last["hlo_pipe"][kind]["pipe_sends_independent"] is True
+        assert last["hlo_pipe"][kind]["slot_bodies"] >= 1
+    assert last["hlo_pipe"]["zb"]["dw_ops_present"] is True
+    # activation residency: AD-through-the-loop gpipe saves every
+    # tick's residuals; 1f1b keeps the in-flight window and recomputes
+    assert last["live_range_ok"] is True
+    assert last["temp_bytes"]["1f1b"] < last["temp_bytes"]["gpipe"]

@@ -342,9 +342,9 @@ class TestRefusals:
         # MoE: expert dispatch needs in-region handling
         with pytest.raises(ValueError, match="MoE"):
             build("gpt-moe-tiny", cfg("gpt-moe-tiny"), mesh=tp_mesh)
-        # gpt-pipe: already refused at the co-required --scan_layers gate
-        # (stage stacking owns its layout) — the combination cannot arise
-        with pytest.raises(ValueError, match="scan_layers|stage"):
+        # gpt-pipe: pipe×tp refused with the slot-loop reason named
+        # (r16 — --scan_layers itself is now the stage-local scan)
+        with pytest.raises(ValueError, match="pipelined entries"):
             build("gpt-pipe-tiny", cfg("gpt-pipe-tiny"), mesh=tp_mesh)
 
     def test_geometry_level(self, devices):

@@ -330,7 +330,7 @@ def test_registry_refusals(devices):
         build("gpt-moe-tiny",
               TrainingConfig(model="gpt-moe-tiny", scan_layers=True,
                              ddp_overlap=True), mesh=mesh)
-    with pytest.raises(ValueError, match="GPipe pipeline"):
+    with pytest.raises(ValueError, match="pipelined entries"):
         build("gpt-pipe-tiny",
               TrainingConfig(model="gpt-pipe-tiny", scan_layers=True,
                              ddp_overlap=True), mesh=mesh)

@@ -88,15 +88,17 @@ class TestWireCodec:
         assert rows[1][FLEET_WIRE_KEYS[-1]] == 0.0
 
     def test_r15_mem_keys_appended_at_the_end(self):
-        """The version seam, pinned (r15 satellite): the memory columns
-        were APPENDED to FLEET_WIRE_KEYS — prefix order is frozen, so an
-        old peer's rows still align."""
+        """The version seam, pinned (r15 satellite, r16 append): the
+        memory columns and the r16 pipeline-bubble column were APPENDED
+        to FLEET_WIRE_KEYS — prefix order is frozen, so an old peer's
+        rows still align."""
         assert FLEET_WIRE_KEYS[:10] == (
             "step", "step_wall_ms", "frac_input", "frac_device",
             "frac_host", "input_wait_ms", "producer_idle_ms",
             "gp_productive_s", "gp_wall_s", "anomaly")
         assert FLEET_WIRE_KEYS[10:] == ("mem_bytes_in_use",
-                                        "mem_frac_of_limit")
+                                        "mem_frac_of_limit",
+                                        "bubble_frac")
 
     def test_old_width_row_zero_fills_new_mem_keys(self):
         """The documented zero-fill/extra-column tolerance, exercised
@@ -108,7 +110,8 @@ class TestWireCodec:
         new_row = encode_window(window(step=2, wall=7.0,
                                        mem_bytes_in_use=5e8,
                                        mem_frac_of_limit=0.5))
-        assert new_row.shape[0] == OLD_WIDTH + 2
+        # r15 appended the two mem columns, r16 the bubble column
+        assert new_row.shape[0] == OLD_WIDTH + 3
         # old peer's row next to this version's: pad like _default_exchange
         padded = np.zeros_like(new_row)
         padded[:OLD_WIDTH] = old_row

@@ -382,9 +382,11 @@ def test_convert_state_refuses_layerless_tree():
 def test_scan_layers_rejected_where_it_cannot_apply():
     with pytest.raises(ValueError, match="no transformer layer stack"):
         build("mlp", TrainingConfig(model="mlp", scan_layers=True))
-    with pytest.raises(ValueError, match="GPipe pipeline"):
-        build("gpt-pipe-tiny",
-              TrainingConfig(model="gpt-pipe-tiny", scan_layers=True))
+    # gpt-pipe entries now ACCEPT the flag as a stage-local scan (r16)
+    task, _ = build("gpt-pipe-tiny",
+                    TrainingConfig(model="gpt-pipe-tiny",
+                                   scan_layers=True))
+    assert task.scan_layers is True
 
 
 def test_fsdp_prefers_leading_layer_dim():

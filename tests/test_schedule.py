@@ -342,8 +342,10 @@ class TestRefusals:
                   TrainingConfig(model="gpt-moe-tiny", scan_layers=True,
                                  ddp_overlap=True, tp_overlap=True),
                   mesh=mesh)
-        # pipe: the co-required --scan_layers gate names the conflict
-        with pytest.raises(ValueError, match="GPipe pipeline|stage"):
+        # pipe × the scan-family overlap flags: refused with the pipe
+        # composition named (r16 — --scan_layers itself is now the
+        # stage-local scan and accepted)
+        with pytest.raises(ValueError, match="pipelined entries"):
             build("gpt-pipe-tiny",
                   TrainingConfig(model="gpt-pipe-tiny", scan_layers=True,
                                  fsdp_overlap=True, tp_overlap=True),

@@ -35,15 +35,52 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
-def convert_state(state, to: str):
-    """Return ``state`` restacked into layout ``to`` ("scanned"/"unrolled"),
-    failing with intent when the tree is already there (or has no layer
-    stack at all — e.g. an MLP/ResNet checkpoint)."""
+def convert_state(state, to: str, pipe_stages: int | None = None):
+    """Return ``state`` restacked into layout ``to`` ("scanned" /
+    "unrolled" / "pipelined"), failing with intent when the tree is
+    already there (or has no layer stack at all — e.g. an MLP/ResNet
+    checkpoint).
+
+    The pipelined entries (models/gpt_pipe.py) stack their block
+    weights ``(n_stages, layers_per_stage, ...)`` under one ``blocks``
+    subtree. ``--to pipelined --pipe_stages N`` restacks onto N stages
+    (the resharding move: resume the same run on a different pipe
+    degree); ``--to scanned``/``--to unrolled`` on a pipelined
+    checkpoint convert its blocks to the r7 layer layouts (the
+    interchange forms) — all conversions are lossless reshapes,
+    round-tripping bit-exact (tests/test_pipeline.py).
+    """
     from pytorch_ddp_template_tpu.parallel.stacking import (
-        detect_layer_layout, restack_layer_trees, unroll_layer_trees,
+        detect_layer_layout, detect_pipe_stages, layer_stack_to_pipe,
+        pipe_to_layer_stack, repipe_stage_trees, restack_layer_trees,
+        unroll_layer_trees,
     )
 
-    have = detect_layer_layout(state)
+    pipe_p = detect_pipe_stages(state)
+    have = "pipelined" if pipe_p else detect_layer_layout(state)
+    if to == "pipelined":
+        if pipe_stages is None or pipe_stages < 2:
+            raise ValueError(
+                "--to pipelined needs --pipe_stages N (N >= 2): the "
+                "stage count of the target pipe mesh axis")
+        if have == "pipelined":
+            if pipe_stages == pipe_p:
+                raise ValueError(
+                    f"checkpoint is already stacked for {pipe_p} "
+                    "pipeline stages; converting would be a no-op")
+            return repipe_stage_trees(state, pipe_stages)
+        if have == "none":
+            raise ValueError(
+                "checkpoint holds no 'blocks' layer stack to split into "
+                "pipeline stages — pipelined layouts serve the gpt-pipe "
+                "entries only"
+            )
+        if have == "unrolled":
+            state = restack_layer_trees(state)
+        return layer_stack_to_pipe(state, pipe_stages)
+    if have == "pipelined":
+        state = pipe_to_layer_stack(state)  # now the scanned spelling
+        return state if to == "scanned" else unroll_layer_trees(state)
     if have == "none":
         raise ValueError(
             "checkpoint holds no transformer layer stack (neither layer_{i} "
@@ -60,7 +97,8 @@ def convert_state(state, to: str):
 
 
 def convert_checkpoint(src: str, dst: str, to: str,
-                       step: int | None = None) -> int:
+                       step: int | None = None,
+                       pipe_stages: int | None = None) -> int:
     """Convert one step of ``src`` into a fresh checkpoint tree at ``dst``;
     returns the converted step number."""
     import json
@@ -79,9 +117,10 @@ def convert_checkpoint(src: str, dst: str, to: str,
         step, state, cfg = src_mngr.restore_raw(step)
     finally:
         src_mngr.close()
-    converted = convert_state(state, to)
+    converted = convert_state(state, to, pipe_stages=pipe_stages)
     cfg = dict(cfg or {})
-    cfg["scan_layers"] = to == "scanned"
+    if to != "pipelined":
+        cfg["scan_layers"] = to == "scanned"
     # provenance keys (_native_rng, _train_batch_size) are recomputed by
     # save() from the reconstructed config — no manual carry-over needed
     config = TrainingConfig.from_json(json.dumps(cfg))
@@ -101,12 +140,19 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--dst", required=True,
                    help="directory for the converted checkpoint (must "
                         "differ from --src)")
-    p.add_argument("--to", required=True, choices=["scanned", "unrolled"],
-                   help="destination layer layout")
+    p.add_argument("--to", required=True,
+                   choices=["scanned", "unrolled", "pipelined"],
+                   help="destination layer layout (pipelined = the "
+                        "gpt-pipe (n_stages, layers_per_stage, ...) "
+                        "stage stacking; needs --pipe_stages)")
     p.add_argument("--step", type=int, default=None,
                    help="checkpoint step to convert (default: latest)")
+    p.add_argument("--pipe_stages", type=int, default=None,
+                   help="target pipeline stage count for --to pipelined "
+                        "(must divide the layer count)")
     args = p.parse_args(argv)
-    step = convert_checkpoint(args.src, args.dst, args.to, args.step)
+    step = convert_checkpoint(args.src, args.dst, args.to, args.step,
+                              pipe_stages=args.pipe_stages)
     print(f"converted step {step}: {args.src} -> {args.dst} ({args.to})")
 
 

@@ -19,10 +19,10 @@
 # bench mode appends one JSON line to its round's records file.
 # Usage: bash tools/tpu_followup.sh <round>   (requires the axon tunnel)
 set -u
-ROUND=${1:?usage: tpu_followup.sh <round: 4..15>}
+ROUND=${1:?usage: tpu_followup.sh <round: 4..16>}
 case "$ROUND" in (*[!0-9]*|'') echo "round must be a number, got '$ROUND'" >&2; exit 2;; esac
-if [ "$ROUND" -lt 4 ] || [ "$ROUND" -gt 15 ]; then
-  echo "unknown round $ROUND (expected 4..15)" >&2; exit 2
+if [ "$ROUND" -lt 4 ] || [ "$ROUND" -gt 16 ]; then
+  echo "unknown round $ROUND (expected 4..16)" >&2; exit 2
 fi
 cd "$(dirname "$0")/.."
 R=bench_records
@@ -228,6 +228,36 @@ legs_r15() {
   python tools/bench_diff.py "$R" "$R/mem_tpu_r15.jsonl" --format github \
     > "$R/bench_diff_tpu_r15.md" 2>>"$ERR" \
     || echo "bench_diff flagged drift (see bench_diff_tpu_r15.md)" >&2
+}
+
+legs_r16() {
+  # pipeline schedules: the r16 real-multi-chip data the 1-core CPU
+  # record cannot produce — the CPU host time-slices its 8 virtual
+  # devices, so its wall-clock tracks TOTAL work and the lockstep
+  # bubble win (zb's whole point) is invisible there. On >= 4 real
+  # chips: (a) the gpipe/1f1b/zb step-time triplet at the committed
+  # bubble-dominated geometry (small M, the drain bubble dominates) —
+  # expect 1f1b ~= gpipe and zb strictly faster, tracking the
+  # schedule-model bubble fractions in the record; (b) a deeper-M leg
+  # where 1f1b's O(P) activation residency beats gpipe's O(M) on real
+  # HBM watermarks (compose with legs_r15's measured watermarks); (c)
+  # a bubble-fraction trace leg: --perf_report + --hlo_report on the
+  # acceptance config exports tpuddp_perf_bubble_frac and the pipe
+  # tripwire on real lowering. Flagged degenerate on < 4 chips.
+  run pipe_triplet pipe_tpu_r16.jsonl 2400 BENCH_MODE=pipe BENCH_MICRO=2 BENCH_PIPE=4 BENCH_STEPS=20 BENCH_WARMUP=3
+  run pipe_deep_m pipe_tpu_r16.jsonl 2400 BENCH_MODE=pipe BENCH_MICRO=8 BENCH_MICRO_MEM=16 BENCH_PIPE=2 BENCH_STEPS=20 BENCH_WARMUP=3
+  timeout 1200 python ddp.py --model gpt-pipe-tiny --scan_layers \
+    --pipe_schedule zb --mesh data:2,pipe:2 --perf_report --hlo_report \
+    --status_port 8092 --max_steps 30 --per_device_train_batch_size 8 \
+    --logging_steps 5 --save_steps 0 --dataset_size 2048 --no_resume \
+    --output_dir /tmp/pipe_tpu_r16 2>>"$ERR" &
+  local train_pid=$!
+  sleep 45
+  curl -sf http://127.0.0.1:8092/metrics > "$R/pipe_metrics_tpu_r16.prom" \
+    2>>"$ERR" && echo "pipe /metrics scraped (tpuddp_perf_bubble_frac)" >&2
+  wait "$train_pid" || RC=1
+  cp /tmp/pipe_tpu_r16/hlo_report.json "$R/pipe_hlo_report_tpu_r16.json" \
+    2>/dev/null && echo "pipe hlo_report (tripwire clean?) copied" >&2
 }
 
 # -- the historical chain ---------------------------------------------------
