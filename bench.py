@@ -50,7 +50,7 @@ from pytorch_ddp_template_tpu.obs.attribution import (  # noqa: E402
     PEAK_FLOPS, cost_of,
 )
 
-MODE = os.environ.get("BENCH_MODE", "train")  # train | e2e | scaling | flash | compile | overlap | comms | tp | overlap3d | obs | perf | fleet | mem | pipe
+MODE = os.environ.get("BENCH_MODE", "train")  # train | e2e | scaling | flash | compile | overlap | comms | tp | overlap3d | obs | perf | fleet | mem | pipe | quant
 MODEL = os.environ.get("BENCH_MODEL", "resnet50")
 WARMUP_STEPS = int(os.environ.get("BENCH_WARMUP", "5"))
 TIMED_STEPS = int(os.environ.get("BENCH_STEPS", "30"))
@@ -72,7 +72,7 @@ def _emit(payload: dict) -> None:
 #: HEADLINE config during an outage
 ABLATION_KEYS = ("remat", "fused_head", "dense_head", "flash_disabled",
                  "num_layers", "scan_layers", "ddp_overlap", "tp_overlap",
-                 "fsdp_overlap")
+                 "fsdp_overlap", "quant_compute")
 
 
 def _last_recorded(metric: str) -> dict | None:
@@ -357,6 +357,15 @@ def run_bench(model: str, metric: str, unit: str, baseline: float,
                 f"BENCH_FSDP_OVERLAP: model {model!r} has no decomposed-"
                 "FSDP execution path")
         task.model = task.model.clone(fsdp_overlap=True, mesh=mesh)
+    quant = os.environ.get("BENCH_QUANT", "off")  # r17 quant-compute leg
+    if quant not in ("off", "int8", "fp8"):
+        raise ValueError(f"BENCH_QUANT={quant!r}: expected off|int8|fp8")
+    if quant != "off":
+        if not hasattr(task.model, "quant_compute"):
+            raise ValueError(
+                f"BENCH_QUANT: model {model!r} has no transformer block "
+                "matmuls to quantize")
+        task.model = task.model.clone(quant_compute=quant)
 
     global_batch = per_device * data_size
     idx = np.arange(global_batch) % len(dataset)
@@ -439,6 +448,8 @@ def run_bench(model: str, metric: str, unit: str, baseline: float,
         out["mesh"] = mesh_spec
     if fsdp_overlap:
         out["fsdp_overlap"] = True
+    if quant != "off":
+        out["quant_compute"] = quant  # ablation-keyed: narrow-dot run
     if os.environ.get("FLASH_DISABLE", "") == "1":
         out["flash_disabled"] = True
     try:  # compiled-executable memory breakdown (peak-memory evidence for
@@ -2895,6 +2906,308 @@ def run_pipe() -> dict:
     }
 
 
+def run_quant() -> dict:
+    """Low-precision compute proof (``--quant_compute {int8,fp8}``,
+    ops/quant.py + the quantized ring kernels in
+    parallel/collective_matmul.py): scaled narrow dots in the scanned
+    block matmuls and, composed with ``--tp_overlap``, narrow ring
+    payloads — wire and FLOPs shrink together.
+
+    Six legs, sized for what THIS host can prove (the real-TPU fp8/int8
+    step-time pair and the narrow-MXU FLOPs win ride in
+    ``tools/tpu_followup.sh legs_r17``):
+
+    - **off bit-parity**: one optimizer step from identical init with
+      ``quant_compute="off"`` passed explicitly vs the untouched default
+      path — MUST be bit-equal (the flag's off position may not perturb
+      the shipped numerics, pinned here and by test). Both builds are
+      the same construction by design, so the comparison alone only
+      proves determinism — the off build additionally traces with the
+      quant entry point POISONED and its compiled program is censused
+      for narrow dtypes (either tripping aborts the leg).
+    - **roundtrip bounds**: ``dequantize(quantize(x))`` max per-channel
+      error vs the documented bound per dtype
+      (``ops.quant.roundtrip_rel_error_bound``).
+    - **FLOPs-matched step ratio**: fp32 vs int8 vs fp8 on the same
+      scanned stack. CPU caveat (recorded, not hidden): this host has no
+      narrow MXU — XLA upcasts the operands, so the ratio prices the
+      quantize/dequantize overhead; the FLOPs win needs the real
+      hardware's int8/fp8 path (obs/attribution.py per-dtype peaks).
+    - **ring wire**: quantized stack wire vs fp32 at the tp geometry
+      (exact accounting; the headline — the acceptance bar is <= 0.5x).
+    - **HLO quant tripwire**: the compiled quant step must carry
+      narrow-fed dots; the tp leg additionally narrow ppermutes with
+      the quantization hoisted out of the ring loops
+      (``obs/hlo_report.quant_evidence`` — the same walker
+      ``--hlo_report`` runs in production), and
+      ``check_overlap_expectations`` must return NO quant warnings.
+    - **convergence pair** (r9 convention: small constant LR, the
+      tracking regime): fp32 vs int8 vs fp8 loss curves from identical
+      init — mean abs deviation + final losses + the train-works
+      boolean; the fp32-master + re-derived-quantization claim measured
+      end-to-end, not only asserted by unit.
+
+    Knobs: BENCH_DEPTH (default 4), BENCH_SEQ, BENCH_BATCH,
+    BENCH_STEPS/BENCH_WARMUP, BENCH_CONV_STEPS (default 120),
+    BENCH_CONV_LR (default 0.005).
+    """
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pytorch_ddp_template_tpu.config import TrainingConfig
+    from pytorch_ddp_template_tpu.models.gpt import CausalLmTask, GptDecoder
+    from pytorch_ddp_template_tpu.obs.hlo_report import (
+        check_overlap_expectations, quant_evidence, schedule_report,
+    )
+    from pytorch_ddp_template_tpu.ops.quant import (
+        dequantize, quantize_channel, roundtrip_rel_error_bound,
+    )
+    from pytorch_ddp_template_tpu.parallel.collective_matmul import (
+        tp_wire_bytes_per_step,
+    )
+    from pytorch_ddp_template_tpu.parallel.sharding import shard_tree
+    from pytorch_ddp_template_tpu.runtime import make_mesh
+    from pytorch_ddp_template_tpu.train.engine import (
+        TrainState,
+        make_optimizer,
+        make_train_step,
+    )
+
+    depth = int(os.environ.get("BENCH_DEPTH", "0")) or 4
+    seq = int(os.environ.get("BENCH_SEQ", "128"))
+    conv_steps = int(os.environ.get("BENCH_CONV_STEPS", "120"))
+    conv_lr = float(os.environ.get("BENCH_CONV_LR", "0.005"))
+    vocab = 256
+    devices = jax.devices()
+    n_dev = len(devices)
+    tp_size = 2 if n_dev % 2 == 0 and n_dev >= 2 else 1
+    mesh = make_mesh(f"data:{n_dev}", devices)
+    batch_size = (PER_DEVICE_BATCH or 2) * n_dev
+    key = jax.random.PRNGKey(0)
+    WIDE = dict(num_heads=4, head_dim=32, mlp_dim=1024, seq=seq)
+    NARROW = dict(num_heads=2, head_dim=32, mlp_dim=128, seq=64)
+
+    def make_batch(m, spec_seq):
+        ids = np.random.default_rng(0).integers(
+            0, vocab, (batch_size, spec_seq))
+        return {"input_ids": jax.device_put(
+            np.asarray(ids, np.int32), NamedSharding(m, P("data")))}
+
+    def build_state(spec, m, *, quant=None, tp=False, lr=1e-2,
+                    schedule_kind="linear"):
+        config = TrainingConfig(warmup_steps=0, max_grad_norm=1000.0,
+                                learning_rate=lr, lr_schedule=schedule_kind)
+        batch = make_batch(m, spec["seq"])
+        kwargs = {}
+        if quant is not None:
+            kwargs["quant_compute"] = quant
+        model = GptDecoder(vocab_size=vocab, max_len=spec["seq"],
+                           num_layers=depth, num_heads=spec["num_heads"],
+                           head_dim=spec["head_dim"],
+                           mlp_dim=spec["mlp_dim"], scan_layers=True,
+                           tp_overlap=tp, fused_head=tp,
+                           mesh=m if tp else None, **kwargs)
+        task = CausalLmTask(model)
+        params, extra = task.init(key, batch)
+        tx, schedule = make_optimizer(config, total_steps=10_000)
+        state = TrainState(
+            step=jnp.zeros((), jnp.int32), params=params, extra_vars=extra,
+            opt_state=tx.init(params), rng=jax.random.clone(key))
+        state = shard_tree(state, m)
+        compiled = make_train_step(task, tx, schedule).lower(
+            state, batch).compile()
+        return compiled, state, batch
+
+    # -- off bit-parity leg ------------------------------------------------
+    # 'default' omits the kwarg and the model's quant_compute defaults to
+    # "off", so the param comparison alone proves compile determinism,
+    # not the claim. The claim — off never touches the quant machinery —
+    # is pinned by poisoning the quant entry point while the off variant
+    # traces, and by a narrow-dtype census over its compiled program:
+    # either tripping fails the leg loudly (no record is emitted).
+    from pytorch_ddp_template_tpu.obs.hlo_report import NARROW_DTYPES
+    from pytorch_ddp_template_tpu.ops import quant as _quant_ops
+
+    def _poisoned_quant_dense(*_a, **_k):
+        raise AssertionError(
+            "quant_compute=off reached ops.quant.quant_dense — the off "
+            "dispatch is no longer the plain path")
+
+    slots = {}
+    _orig_quant_dense = _quant_ops.quant_dense
+    for kind, q in (("default", None), ("off", "off")):
+        if kind == "off":
+            _quant_ops.quant_dense = _poisoned_quant_dense
+        try:
+            compiled, state, batch = build_state(WIDE, mesh, quant=q)
+        finally:
+            _quant_ops.quant_dense = _orig_quant_dense
+        if kind == "off":
+            off_hlo = compiled.as_text()
+            narrow_leaked = [d for d in NARROW_DTYPES if f"{d}[" in off_hlo]
+            assert not narrow_leaked, (
+                f"quant_compute=off compiled program carries narrow "
+                f"dtypes {narrow_leaked} — the off path is quantizing")
+        state, metrics = compiled(state, batch)
+        slots[kind] = (state, float(metrics["loss"]))
+    parity_off = max(
+        float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32))))
+        for a, b in zip(jax.tree.leaves(slots["default"][0].params),
+                        jax.tree.leaves(slots["off"][0].params)))
+
+    # -- roundtrip bound leg -----------------------------------------------
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.standard_normal((64, 256)).astype(np.float32) * 3)
+    roundtrip = {}
+    for mode in ("int8", "fp8"):
+        q, s = quantize_channel(x, mode, axes=-1)
+        err = jnp.max(jnp.abs(dequantize(q, s) - x), axis=-1)
+        amax = jnp.max(jnp.abs(x), axis=-1)
+        rel = float(jnp.max(err / amax))
+        bound = roundtrip_rel_error_bound(mode)
+        roundtrip[mode] = {"max_rel_err": rel, "bound": bound,
+                           "ok": rel <= bound + 1e-7}
+
+    # -- FLOPs-matched step-time leg ---------------------------------------
+    variants = {}
+    for kind in ("fp32", "int8", "fp8"):
+        q = None if kind == "fp32" else kind
+        compiled, state, batch = build_state(WIDE, mesh, quant=q)
+        metrics = None
+        for _ in range(WARMUP_STEPS):
+            state, metrics = compiled(state, batch)
+        if metrics is not None:
+            float(metrics["loss"])
+        variants[kind] = [compiled, state, batch]
+    step_ms = {}
+    for _rep in range(3):
+        for kind, slot in variants.items():
+            compiled, state, batch = slot
+            t0 = time.perf_counter()
+            for _ in range(TIMED_STEPS):
+                state, metrics = compiled(state, batch)
+            loss = float(metrics["loss"])
+            dt = time.perf_counter() - t0
+            slot[1] = state
+            assert np.isfinite(loss), f"non-finite loss {loss}"
+            ms = 1e3 * dt / TIMED_STEPS
+            step_ms[kind] = min(step_ms.get(kind, ms), ms)
+
+    # -- HLO tripwire leg (data-only: narrow dots) -------------------------
+    hlo_data = quant_evidence(variants["int8"][0].as_text())
+
+    # -- tp legs: narrow ring wire + hoisted-quantize witness --------------
+    tp_out: dict = {"degenerate": tp_size == 1}
+    if tp_size > 1:
+        tpmesh = make_mesh(f"data:{n_dev // tp_size},model:{tp_size}",
+                           devices)
+        compiled_tp, state_tp, batch_tp = build_state(
+            WIDE, tpmesh, quant="int8", tp=True)
+        txt = compiled_tp.as_text()
+        hlo_tp = quant_evidence(txt)
+        cfg_probe = TrainingConfig(
+            model="gpt-tiny", scan_layers=True, tp_overlap=True,
+            quant_compute="int8", mesh=f"data:{n_dev // tp_size},"
+            f"model:{tp_size}")
+        quant_warns = [w for w in check_overlap_expectations(
+            schedule_report(txt), cfg_probe, dict(tpmesh.shape))
+            if "quant" in w]
+        # one verified step: the quantized ring path must train
+        state_tp, m_tp = compiled_tp(state_tp, batch_tp)
+        assert np.isfinite(float(m_tp["loss"]))
+        tp_out = {
+            "degenerate": False,
+            "hlo_tp_narrow_ppermutes": hlo_tp["narrow_ppermutes"],
+            "hlo_tp_narrow_dots": hlo_tp["narrow_dots"],
+            "hlo_tp_hoisted_ring_bodies":
+                hlo_tp["hoisted_quant_ring_bodies"],
+            "hlo_tp_quant_warnings": quant_warns,
+        }
+    wire_kw = dict(batch=batch_size, seq=seq,
+                   embed=WIDE["num_heads"] * WIDE["head_dim"],
+                   num_layers=depth, n=max(tp_size, 2), vocab=vocab)
+    wire_fp32 = tp_wire_bytes_per_step(**wire_kw)
+    wires = {m: tp_wire_bytes_per_step(quant=m, **wire_kw)
+             for m in ("int8", "fp8")}
+    ratio_int8 = wires["int8"]["stack"] / max(wire_fp32["stack"], 1)
+    ratio_fp8 = wires["fp8"]["stack"] / max(wire_fp32["stack"], 1)
+
+    # -- convergence-tracking pair (r9 convention) -------------------------
+    curves: dict[str, list[float]] = {}
+    for kind in ("fp32", "int8", "fp8"):
+        q = None if kind == "fp32" else kind
+        compiled, state, batch = build_state(
+            NARROW, mesh, quant=q, lr=conv_lr, schedule_kind="constant")
+        losses = []
+        for _ in range(conv_steps):
+            state, metrics = compiled(state, batch)
+            losses.append(float(metrics["loss"]))
+        curves[kind] = losses
+    ref = np.asarray(curves["fp32"])
+    dev_int8 = float(np.mean(np.abs(np.asarray(curves["int8"]) - ref)))
+    dev_fp8 = float(np.mean(np.abs(np.asarray(curves["fp8"]) - ref)))
+
+    # tp-degenerate host (odd/single device count): the ring legs never
+    # compiled or ran, so the headline may not claim the ring saving off
+    # the phantom n=2 wire math — emit degenerate:true with value 0 (the
+    # r8 convention); the wire_mb_* fields stay as static accounting
+    tp_degenerate = tp_size == 1
+    return {
+        # headline spelled higher-is-better (the bench_diff invariant —
+        # a lower-is-better ratio would invert the CI tripwire): the
+        # fp32-over-narrow wire saving factor. Acceptance bar: saving
+        # >= 2x (narrow <= 0.5x fp32), so vs_baseline >= 1.0 passes
+        "metric": f"quant_ring_wire_saving_int8_{depth}L",
+        "value": (0.0 if tp_degenerate
+                  else round(1.0 / max(ratio_int8, 1e-9), 4)),
+        "unit": "x_fp32_over_int8_ring_stack_bytes",
+        "vs_baseline": (0.0 if tp_degenerate
+                        else round(0.5 / max(ratio_int8, 1e-9), 4)),
+        "platform": devices[0].platform,
+        "device_kind": devices[0].device_kind,
+        "n_devices": n_dev,
+        "depth": depth,
+        "seq_len": seq,
+        "batch": batch_size,
+        "model_dims": {k: v for k, v in WIDE.items() if k != "seq"},
+        "conv_model_dims": NARROW,
+        "timed_steps": TIMED_STEPS,
+        "parity_off_max_abs_diff": parity_off,
+        "parity_off_bitexact": parity_off == 0.0,
+        "roundtrip": roundtrip,
+        "step_time_fp32_ms": round(step_ms["fp32"], 2),
+        "step_time_int8_ms": round(step_ms["int8"], 2),
+        "step_time_fp8_ms": round(step_ms["fp8"], 2),
+        # CPU caveat: no narrow MXU here — this ratio prices the
+        # quantize overhead; the FLOPs win is legs_r17's to measure
+        "step_ratio_int8_vs_fp32": round(
+            step_ms["fp32"] / max(step_ms["int8"], 1e-9), 3),
+        "step_ratio_fp8_vs_fp32": round(
+            step_ms["fp32"] / max(step_ms["fp8"], 1e-9), 3),
+        "cpu_no_narrow_mxu": devices[0].platform != "tpu",
+        "hlo_narrow_dots": hlo_data["narrow_dots"],
+        "hlo_quant_dots_present": hlo_data["quant_dots_present"],
+        **tp_out,
+        "wire_mb_fp32_stack": round(wire_fp32["stack"] / 1e6, 3),
+        "wire_mb_int8_stack": round(wires["int8"]["stack"] / 1e6, 3),
+        "wire_mb_fp8_stack": round(wires["fp8"]["stack"] / 1e6, 3),
+        "wire_int8_vs_fp32": round(ratio_int8, 4),
+        "wire_fp8_vs_fp32": round(ratio_fp8, 4),
+        "conv_steps": conv_steps,
+        "conv_lr": conv_lr,
+        "loss_dev_int8": dev_int8,
+        "loss_dev_fp8": dev_fp8,
+        "final_loss_fp32": curves["fp32"][-1],
+        "final_loss_int8": curves["int8"][-1],
+        "final_loss_fp8": curves["fp8"][-1],
+        "int8_trained": curves["int8"][-1] < curves["int8"][0],
+        "fp8_trained": curves["fp8"][-1] < curves["fp8"][0],
+    }
+
+
 def run_scaling(model: str) -> dict:
     """DDP scaling sweep: per-chip throughput on data:1/2/4/... sub-meshes.
 
@@ -3102,6 +3415,8 @@ def main() -> None:
             _emit(run_mem())
         elif MODE == "pipe":
             _emit(run_pipe())
+        elif MODE == "quant":
+            _emit(run_quant())
         elif MODE == "e2e":
             _emit(run_e2e(model, metric, unit, baseline))
         elif MODE == "train":
@@ -3110,7 +3425,7 @@ def main() -> None:
             raise ValueError(
                 f"unknown BENCH_MODE {MODE!r}; expected "
                 "train|e2e|scaling|flash|compile|overlap|comms|tp|"
-                "overlap3d|obs|perf|fleet|mem|pipe"
+                "overlap3d|obs|perf|fleet|mem|pipe|quant"
             )
     except KeyboardInterrupt:  # operator abort is not a value-0 datum
         raise

@@ -127,6 +127,19 @@ class TrainingConfig:
     #                           Needs --scan_layers and a `model` mesh
     #                           axis; composes with --fsdp_overlap /
     #                           --ddp_overlap (r11); MoE/pipe refused
+    quant_compute: str = "off"  # low-precision compute path
+    #                             (ops/quant.py): off | int8 | fp8. The
+    #                             transformer block matmuls
+    #                             (fc1/fc2/qkv/out) run as per-channel
+    #                             scaled narrow dots re-derived from the
+    #                             fp32 master weights every step (the
+    #                             optimizer never sees a quantized
+    #                             value); composed with --tp_overlap the
+    #                             ring collective matmuls quantize each
+    #                             chunk once and rotate the narrow
+    #                             tensor + its scales — wire and FLOPs
+    #                             shrink together. Transformer families
+    #                             only; MoE/pipe refused with intent
     remat: bool = False  # rematerialise blocks (peak-memory for FLOPs trade;
     #                      long-context entries default it on regardless)
     scan_layers: bool = False  # drive the transformer block stack as ONE
@@ -347,12 +360,14 @@ class TrainingConfig:
                 "leaves GSPMD-managed data-split weights the ring regions "
                 "cannot serve — pass --fsdp_overlap instead of --fsdp"
             )
-        if self.grad_error_feedback and self.tp_overlap:
+        # EF×tp composes since r17: the residual leaves are sized for the
+        # model-sharded layout (compress.residual_shape_tp), so the
+        # ddp×tp drain's per-shard quantization error telescopes per
+        # (data, model) coordinate — the r11 named refusal, lifted
+        if self.quant_compute not in ("off", "int8", "fp8"):
             raise ValueError(
-                "--grad_error_feedback does not compose with --tp_overlap "
-                "yet: the residual leaves are sized for replicated "
-                "full-width grads, but the ddp×tp drain reduces "
-                "model-sharded slices; drop one of the two"
+                f"unknown --quant_compute {self.quant_compute!r}; "
+                "expected off | int8 | fp8"
             )
         if self.pipe_schedule not in ("gpipe", "1f1b", "zb"):
             raise ValueError(
@@ -679,6 +694,20 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "(ops/lm_head.py): the (B,T,V) logits tensor never "
                         "materialises. gpt-long/bert-long default it on; "
                         "this turns it on for the other LM families.")
+    p.add_argument("--quant_compute", type=str, default="off",
+                   choices=["off", "int8", "fp8"],
+                   help="Low-precision compute path (ops/quant.py): the "
+                        "transformer block matmuls (fc1/fc2/qkv/out) run "
+                        "as per-channel-scaled int8/fp8 dots re-derived "
+                        "from the fp32 master weights every step — the "
+                        "optimizer updates the masters, rounding error "
+                        "never accumulates. Composed with --tp_overlap "
+                        "the ring collective matmuls quantize each chunk "
+                        "once and the ppermute carries the narrow tensor "
+                        "+ its scales (~0.26x the fp32 ring wire), so "
+                        "wire and FLOPs shrink together. fp8 uses e4m3 "
+                        "values / e5m2 cotangents. Transformer families "
+                        "only; MoE and the pipelined entries refused.")
     p.add_argument("--remat", action="store_true",
                    help="Rematerialise model blocks in backward: peak "
                         "activation memory for recompute FLOPs (measured a "

@@ -57,6 +57,10 @@ class BertEncoder(nn.Module):
     # parallel/collective_matmul.py); the tied MLM head rides the same
     # ring (ops/lm_head.tp_lm_head_loss). Needs scan_layers + data×model
     tp_overlap: bool = False
+    # low-precision compute (--quant_compute, ops/quant.py): the block
+    # matmuls run as per-channel-scaled int8/fp8 dots from the fp32
+    # masters; fused into the TP rings when tp_overlap is on
+    quant_compute: str = "off"
     # blockwise tied MLM head (ops/lm_head.py): return the transformed
     # head hidden states; the task applies table+bias vocab-block-wise,
     # so the (B, T, V) logits tensor never exists
@@ -96,6 +100,7 @@ class BertEncoder(nn.Module):
             grad_comm=self.grad_comm,
             grad_error_feedback=self.grad_error_feedback,
             tp_overlap=self.tp_overlap,
+            quant_compute=self.quant_compute,
             name="encoder",
         )
         self.mlm_ln = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")

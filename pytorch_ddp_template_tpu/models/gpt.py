@@ -60,6 +60,10 @@ class GptDecoder(nn.Module):
     # same ring (ops/lm_head.tp_lm_head_loss). Needs scan_layers + a
     # data×model mesh; registry turns fused_head on alongside
     tp_overlap: bool = False
+    # low-precision compute (--quant_compute, ops/quant.py): the block
+    # matmuls run as per-channel-scaled int8/fp8 dots from the fp32
+    # masters; fused into the TP rings when tp_overlap is on
+    quant_compute: str = "off"
     # blockwise tied head (ops/lm_head.py): the model returns final hidden
     # states and the task computes cross-entropy vocab-block-wise — the
     # (B, T, V) logits tensor never exists. The memory enabler for the
@@ -102,6 +106,7 @@ class GptDecoder(nn.Module):
             grad_comm=self.grad_comm,
             grad_error_feedback=self.grad_error_feedback,
             tp_overlap=self.tp_overlap,
+            quant_compute=self.quant_compute,
             name="decoder",
         )(x, train=train)
         x = nn.LayerNorm(dtype=jnp.float32, name="final_ln")(x)

@@ -94,6 +94,15 @@ def build(name: str, config: TrainingConfig, mesh=None) -> tuple[Task, Dataset]:
                     "composes with plain data parallelism (pipe×data) "
                     "only — drop the flag or use a non-pipe entry"
                 )
+        if getattr(config, "quant_compute", "off") != "off":
+            raise ValueError(
+                f"--quant_compute does not compose with the pipelined "
+                f"entries ({name!r}) yet: the zb schedule's tapped "
+                "backward is a bit-exact twin of the block built from "
+                "_plain_dense, and quantized dots inside the slot "
+                "loop's switch branches would break that pin; drop the "
+                "flag or use a non-pipe entry"
+            )
     if config.scan_layers:
         if name.startswith("gpt-pipe"):
             # stage-local scan-over-layers: each stage drives ONE block
@@ -204,6 +213,25 @@ def build(name: str, config: TrainingConfig, mesh=None) -> tuple[Task, Dataset]:
             # (B,T,V) logits tensor must never materialise on any shard
             kwargs["fused_head"] = True
         task.model = task.model.clone(**kwargs)
+    if config.quant_compute != "off":
+        # low-precision compute (ops/quant.py): per-channel scaled
+        # int8/fp8 dots in the block matmuls (and, composed with
+        # --tp_overlap, inside the ring collective matmuls — the clone
+        # above already carries tp_overlap, so the encoder routes the
+        # quantized ring kernels)
+        if not hasattr(task.model, "quant_compute"):
+            raise ValueError(
+                f"--quant_compute: model {name!r} "
+                f"({type(task.model).__name__}) has no transformer block "
+                "matmuls to quantize (transformer families only)"
+            )
+        if getattr(task.model, "moe_experts", 0):
+            raise ValueError(
+                "--quant_compute does not compose with MoE entries yet "
+                "(the expert dispatch and per-expert FFNs have no "
+                "quantized path); drop one of the two"
+            )
+        task.model = task.model.clone(quant_compute=config.quant_compute)
     if config.data_dir:
         from ..data.filestore import MemmapDataset
 

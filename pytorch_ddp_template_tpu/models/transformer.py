@@ -104,6 +104,20 @@ def _plain_dense(x, kernel, bias, n_axes: int, dtype):
     return y + bias.astype(dtype)
 
 
+def _quant_or_plain(x, kernel, bias, n_axes: int, dtype, quant: str,
+                    initializing: bool):
+    """Dispatch one block matmul: the fp32-master low-precision dot
+    (``ops/quant.py``) under ``--quant_compute``, DenseGeneral semantics
+    otherwise. Init always takes the plain path — shapes/params only,
+    and the quantized apply consumes the same ``_DenseParams`` twins, so
+    the param tree stays bit-interchangeable with the default path."""
+    if initializing or quant == "off":
+        return _plain_dense(x, kernel, bias, n_axes, dtype)
+    from ..ops.quant import quant_dense
+
+    return quant_dense(x, kernel, bias, n_axes, quant, dtype)
+
+
 class MultiHeadAttention(nn.Module):
     """Self-attention with fused-qkv-friendly layout and op dispatch.
 
@@ -130,6 +144,12 @@ class MultiHeadAttention(nn.Module):
     # second region; num_heads/head_dim still describe the GLOBAL
     # geometry, the local arrays carry the per-shard slices
     tp_local: bool = False
+    # low-precision compute (--quant_compute, ops/quant.py): qkv/out run
+    # as per-channel-scaled int8/fp8 dots from the fp32 masters — via the
+    # quantized ring kernels under tp_overlap (the ppermute carries the
+    # narrow tensor), via quant_dense otherwise; param tree unchanged
+    # (_DenseParams twins)
+    quant_compute: str = "off"
 
     def _tp_qkv(self, x):
         from ..parallel.collective_matmul import (
@@ -151,8 +171,10 @@ class MultiHeadAttention(nn.Module):
         kernels = [k.astype(self.dtype) for k in kernels]
         biases = [b.astype(self.dtype) for b in biases]
         if self.tp_local:
-            return tp_column_dense_local(x, kernels, biases)
-        return tp_column_dense(x, kernels, biases, self.mesh)
+            return tp_column_dense_local(x, kernels, biases,
+                                         quant=self.quant_compute)
+        return tp_column_dense(x, kernels, biases, self.mesh,
+                               quant=self.quant_compute)
 
     def _tp_out(self, out, features):
         from ..parallel.collective_matmul import (
@@ -167,16 +189,43 @@ class MultiHeadAttention(nn.Module):
         if self.tp_local:
             return tp_row_dense_local(out.astype(self.dtype),
                                       kernel.astype(self.dtype),
-                                      bias.astype(self.dtype))
+                                      bias.astype(self.dtype),
+                                      quant=self.quant_compute)
         return tp_row_dense(out.astype(self.dtype),
                             kernel.astype(self.dtype),
-                            bias.astype(self.dtype), self.mesh)
+                            bias.astype(self.dtype), self.mesh,
+                            quant=self.quant_compute)
+
+    def _quant_qkv(self, x):
+        """Non-TP low-precision qkv: the same ``_DenseParams`` twins the
+        ring path uses, applied through ``ops.quant.quant_dense`` —
+        checkpoints stay bit-interchangeable with the DenseGeneral
+        path."""
+        embed = x.shape[-1]
+        params = [
+            _DenseParams((embed,), (self.num_heads, self.head_dim),
+                         ("embed", "heads", "kv"), name=name)()
+            for name in ("query", "key", "value")
+        ]
+        return [_quant_or_plain(x, k, b, 1, self.dtype,
+                                self.quant_compute,
+                                self.is_initializing())
+                for k, b in params]
+
+    def _quant_out(self, out, features):
+        kernel, bias = _DenseParams(
+            (self.num_heads, self.head_dim), (features,),
+            ("heads", "kv", "embed"), name="out")()
+        return _quant_or_plain(out, kernel, bias, 2, self.dtype,
+                               self.quant_compute, self.is_initializing())
 
     @nn.compact
     def __call__(self, x, mask=None, *, train: bool = True):
         features = x.shape[-1]
         if self.tp_overlap:
             q, k, v = self._tp_qkv(x)
+        elif self.quant_compute != "off":
+            q, k, v = self._quant_qkv(x)
         else:
             proj = lambda name: nn.DenseGeneral(
                 (self.num_heads, self.head_dim),
@@ -219,6 +268,8 @@ class MultiHeadAttention(nn.Module):
                             impl=self.attn_impl)
         if self.tp_overlap:
             out = self._tp_out(out, features)
+        elif self.quant_compute != "off":
+            out = self._quant_out(out, features)
         else:
             out = nn.DenseGeneral(
                 features,
@@ -254,6 +305,10 @@ class MlpBlock(nn.Module):
     tp_overlap: bool = False
     tp_local: bool = False  # already inside a model-axis shard_map region
     mesh: jax.sharding.Mesh | None = None
+    # low-precision compute (--quant_compute): fc1/fc2 as scaled int8/fp8
+    # dots — quantized ring kernels under tp_overlap, quant_dense
+    # otherwise; fp32 masters, param tree unchanged
+    quant_compute: str = "off"
 
     @nn.compact
     def __call__(self, x, *, train: bool = True):
@@ -271,11 +326,12 @@ class MlpBlock(nn.Module):
             elif self.tp_local:
                 (h,) = tp_column_dense_local(
                     x.astype(self.dtype), [k1.astype(self.dtype)],
-                    [b1.astype(self.dtype)])
+                    [b1.astype(self.dtype)], quant=self.quant_compute)
             else:
                 (h,) = tp_column_dense(
                     x.astype(self.dtype), [k1.astype(self.dtype)],
-                    [b1.astype(self.dtype)], self.mesh)
+                    [b1.astype(self.dtype)], self.mesh,
+                    quant=self.quant_compute)
             h = self.act(h)
             k2, b2 = _DenseParams((self.mlp_dim,), (features,),
                                   ("mlp", "embed"), name="fc2")()
@@ -284,11 +340,23 @@ class MlpBlock(nn.Module):
             elif self.tp_local:
                 h = tp_row_dense_local(h.astype(self.dtype),
                                        k2.astype(self.dtype),
-                                       b2.astype(self.dtype))
+                                       b2.astype(self.dtype),
+                                       quant=self.quant_compute)
             else:
                 h = tp_row_dense(h.astype(self.dtype),
                                  k2.astype(self.dtype),
-                                 b2.astype(self.dtype), self.mesh)
+                                 b2.astype(self.dtype), self.mesh,
+                                 quant=self.quant_compute)
+        elif self.quant_compute != "off":
+            k1, b1 = _DenseParams((features,), (self.mlp_dim,),
+                                  ("embed", "mlp"), name="fc1")()
+            h = _quant_or_plain(x, k1, b1, 1, self.dtype,
+                                self.quant_compute, self.is_initializing())
+            h = self.act(h)
+            k2, b2 = _DenseParams((self.mlp_dim,), (features,),
+                                  ("mlp", "embed"), name="fc2")()
+            h = _quant_or_plain(h, k2, b2, 1, self.dtype,
+                                self.quant_compute, self.is_initializing())
         else:
             h = _dense(self.mlp_dim, self.dtype, "fc1", ("embed", "mlp"))(x)
             h = self.act(h)
@@ -315,6 +383,8 @@ class EncoderBlock(nn.Module):
     tp_local: bool = False  # already inside a model-axis shard_map region
     #                         (the ddp×tp composed schedule): geometry
     #                         fields then describe the PER-SHARD slice
+    quant_compute: str = "off"  # low-precision fc1/fc2/qkv/out dots
+    #                             (--quant_compute, ops/quant.py)
 
     @nn.compact
     def __call__(self, x, mask=None, train: bool = True):
@@ -325,6 +395,7 @@ class EncoderBlock(nn.Module):
             self.num_heads, self.head_dim, self.dtype,
             self.dropout_rate, self.attn_impl, self.mesh, self.causal,
             tp_overlap=self.tp_overlap, tp_local=self.tp_local,
+            quant_compute=self.quant_compute,
             name="attention",
         )
         if self.moe_experts:
@@ -337,6 +408,7 @@ class EncoderBlock(nn.Module):
             mlp = MlpBlock(self.mlp_dim, self.dtype, self.dropout_rate,
                            tp_overlap=self.tp_overlap,
                            tp_local=self.tp_local, mesh=self.mesh,
+                           quant_compute=self.quant_compute,
                            name="mlp")
         if self.pre_norm:
             x = x + attn(ln("ln_attn")(x).astype(self.dtype), mask, train=train)
@@ -407,6 +479,28 @@ class TransformerEncoder(nn.Module):
     # same way. Requires scan_layers and a data×model mesh; MoE and the
     # other overlap modes refused with intent.
     tp_overlap: bool = False
+    # low-precision compute (--quant_compute {off,int8,fp8},
+    # ops/quant.py): the block matmuls (fc1/fc2/qkv/out) run as
+    # per-channel-scaled narrow dots from the fp32 masters — fused into
+    # the ring collective matmuls under tp_overlap (the ppermute carries
+    # the narrow tensor + scales), via quant_dense otherwise. Param tree
+    # bit-interchangeable with the default path (_DenseParams twins);
+    # MoE refused with intent (the expert dispatch has no quant path)
+    quant_compute: str = "off"
+
+    def _validate_quant(self) -> None:
+        from ..ops.quant import QUANT_COMPUTE_MODES
+
+        if self.quant_compute not in QUANT_COMPUTE_MODES:
+            raise ValueError(
+                f"unknown quant_compute mode {self.quant_compute!r}; "
+                f"expected one of {QUANT_COMPUTE_MODES}")
+        if self.moe_experts:
+            raise ValueError(
+                "--quant_compute does not compose with MoE blocks yet "
+                "(the expert dispatch and per-expert FFNs have no "
+                "quantized path); drop one of the two"
+            )
 
     def _validate_tp(self, x) -> None:
         from ..parallel.collective_matmul import (
@@ -429,13 +523,6 @@ class TransformerEncoder(nn.Module):
                 "--tp_overlap does not compose with MoE blocks yet (the "
                 "expert dispatch needs in-region handling); drop one of "
                 "the two"
-            )
-        if self.ddp_overlap and self._ef_active:
-            raise ValueError(
-                "--grad_error_feedback does not compose with --tp_overlap "
-                "yet: the residual leaves are sized for replicated "
-                "full-width grads, but the ddp×tp drain reduces "
-                "model-sharded slices; drop one of the two"
             )
         if self.attn_impl in ("ring", "ulysses"):
             raise ValueError(
@@ -461,9 +548,12 @@ class TransformerEncoder(nn.Module):
         Task.init drives, the stacked subtree in a direct scanned init).
         Declared at the encoder level in both twins, so the collection
         path — which the engine round-trips through TrainState — is
-        layout-independent."""
+        layout-independent. Composed with ``tp_overlap`` (r17, the r11
+        named refusal lifted) each leaf is sized for the model-SHARDED
+        local grads the ddp×tp drain reduces: ``(L, data, model,
+        padded_local)`` per ``compress.residual_shape_tp``."""
         from ..parallel.compress import init_residual
-        from ..runtime.context import DATA_AXIS
+        from ..runtime.context import DATA_AXIS, MODEL_AXIS
 
         if self.mesh is None:
             raise ValueError(
@@ -484,8 +574,16 @@ class TransformerEncoder(nn.Module):
             src,
         )
         data_size = self.mesh.shape.get(DATA_AXIS, 1)
+        tp_specs = None
+        model_size = self.mesh.shape.get(MODEL_AXIS, 1)
+        if self.tp_overlap and model_size > 1:
+            from ..parallel.schedule import stacked_tp_specs
+
+            tp_specs = stacked_tp_specs(stacked_shapes, self.mesh)
         self.variable("comm_residual", "residual",
-                      lambda: init_residual(stacked_shapes, data_size))
+                      lambda: init_residual(stacked_shapes, data_size,
+                                            tp_specs=tp_specs,
+                                            model_size=model_size))
 
     def _ddp_forward(self, block_cls, x, mask, train):
         """Drive the stacked block via ``parallel.compress.ddp_overlap_scan``:
@@ -537,6 +635,7 @@ class TransformerEncoder(nn.Module):
             self.dropout_rate, self.pre_norm, self.attn_impl, self.mesh,
             self.causal, moe_experts=self.moe_experts,
             tp_overlap=self.tp_overlap, tp_local=self.tp_overlap,
+            quant_compute=self.quant_compute,
             parent=None, name=SCAN_LAYER_AXIS,
         )
         lossy = self.grad_comm != "fp32"
@@ -642,6 +741,7 @@ class TransformerEncoder(nn.Module):
             self.dropout_rate, self.pre_norm, self.attn_impl, self.mesh,
             self.causal, moe_experts=self.moe_experts,
             tp_overlap=self.tp_overlap,
+            quant_compute=self.quant_compute,
             parent=None, name=SCAN_LAYER_AXIS,
         )
         dropout_rng = None
@@ -673,6 +773,8 @@ class TransformerEncoder(nn.Module):
 
     @nn.compact
     def __call__(self, x, mask=None, *, train: bool = True):
+        if self.quant_compute != "off":
+            self._validate_quant()
         if self.tp_overlap:
             self._validate_tp(x)
         block_cls = EncoderBlock
@@ -695,6 +797,7 @@ class TransformerEncoder(nn.Module):
                 self.dropout_rate, self.pre_norm, self.attn_impl, self.mesh,
                 self.causal, moe_experts=self.moe_experts,
                 tp_overlap=self.tp_overlap,
+                quant_compute=self.quant_compute,
                 name=SCAN_LAYER_AXIS,
             )
 
@@ -727,6 +830,7 @@ class TransformerEncoder(nn.Module):
                 self.dropout_rate, self.pre_norm, self.attn_impl, self.mesh,
                 self.causal, moe_experts=self.moe_experts,
                 tp_overlap=self.tp_overlap,
+                quant_compute=self.quant_compute,
                 name=f"layer_{layer}",
             )
             x = block(x, mask, train) if self.remat else block(

@@ -53,6 +53,10 @@ class VisionTransformer(nn.Module):
     # size — the encoder's divisibility check refuses such geometries
     # with the exact numbers rather than an opaque shard_map error.
     tp_overlap: bool = False
+    # low-precision compute (--quant_compute, ops/quant.py): the block
+    # matmuls run as per-channel-scaled int8/fp8 dots from the fp32
+    # masters; fused into the TP rings when tp_overlap is on
+    quant_compute: str = "off"
     mesh: Any = None
 
     @nn.compact
@@ -105,6 +109,7 @@ class VisionTransformer(nn.Module):
             grad_comm=self.grad_comm,
             grad_error_feedback=self.grad_error_feedback,
             tp_overlap=self.tp_overlap,
+            quant_compute=self.quant_compute,
             name="encoder",
         )(x, train=train)
 

@@ -70,6 +70,31 @@ PEAK_FLOPS = {
     "TPU v2": 45e12,
 }
 
+#: Per-dtype peak rows (r17, ``--quant_compute``): the narrow-format
+#: matmul peaks the low-precision compute path can reach, from the same
+#: public spec sheets. int8 is 2x bf16 on every generation that exposes
+#: it; generations without a narrow MXU path are deliberately ABSENT —
+#: the headroom is then reported as none rather than invented (v2/v3
+#: have no int8 MXU mode; fp8 arrives with Trillium). The attribution
+#: reports the *headroom* (narrow peak / bf16 peak) so the r13 MFU
+#: convention keeps its bf16 denominator and stays comparable across
+#: rounds.
+PEAK_FLOPS_BY_DTYPE = {
+    "bf16": PEAK_FLOPS,
+    "int8": {
+        "TPU v6e": 1836e12,
+        "TPU v6 lite": 1836e12,
+        "TPU v5p": 918e12,
+        "TPU v5e": 394e12,
+        "TPU v5 lite": 394e12,
+        "TPU v4": 275e12,  # v4 int8 runs at the bf16 rate (no 2x path)
+    },
+    "fp8": {
+        "TPU v6e": 1836e12,
+        "TPU v6 lite": 1836e12,
+    },
+}
+
 #: Per-chip interconnect bandwidth (bytes/s, one direction, order-of-
 #: magnitude spec figures) for the comm-time estimate that splits the
 #: device share into compute vs comm. Coarse by design: the split is an
@@ -104,14 +129,20 @@ def _lookup(table: dict[str, float], device_kind: str) -> float | None:
     return next((v for k, v in table.items() if k in device_kind), None)
 
 
-def peak_flops_for(device_kind: str, override_tflops: float = 0.0
-                   ) -> float | None:
-    """Peak bf16 FLOPs/s for MFU: the ``--peak_tflops`` override when
-    given (custom hardware, CPU calibration runs), else the spec table,
-    else None (MFU is then omitted, never invented)."""
+def peak_flops_for(device_kind: str, override_tflops: float = 0.0,
+                   dtype: str = "bf16") -> float | None:
+    """Peak FLOPs/s for MFU: the ``--peak_tflops`` override when given
+    (custom hardware, CPU calibration runs), else the per-dtype spec
+    table (``dtype`` = ``bf16`` | ``int8`` | ``fp8``; the r17 quant
+    rows), else None (MFU/headroom is then omitted, never invented)."""
     if override_tflops and override_tflops > 0:
         return float(override_tflops) * 1e12
-    return _lookup(PEAK_FLOPS, device_kind)
+    table = PEAK_FLOPS_BY_DTYPE.get(dtype)
+    if table is None:
+        raise ValueError(
+            f"peak_flops_for: unknown dtype {dtype!r}; expected one of "
+            f"{sorted(PEAK_FLOPS_BY_DTYPE)}")
+    return _lookup(table, device_kind)
 
 
 def cost_of(compiled) -> dict:
@@ -198,11 +229,23 @@ class PerfAttribution:
 
     def __init__(self, cost_model: dict[str, Any] | None, *,
                  device_kind: str = "", n_devices: int = 1,
-                 peak_tflops_override: float = 0.0):
+                 peak_tflops_override: float = 0.0,
+                 compute_dtype: str = "bf16"):
         self.cost_model = cost_model or {}
         self.n_devices = max(int(n_devices), 1)
         peak1 = peak_flops_for(device_kind, peak_tflops_override)
         self.peak_flops = peak1 * self.n_devices if peak1 else None
+        # r17 low-precision headroom: under --quant_compute the narrow
+        # peak (per-dtype table row) rides alongside — MFU keeps the
+        # bf16 denominator (r13 convention, cross-round comparable) and
+        # the narrow figure is reported next to it, or omitted when the
+        # hardware has no narrow path (never invented)
+        self.compute_dtype = compute_dtype
+        self.quant_peak_flops = None
+        if compute_dtype not in ("bf16", "off"):
+            narrow1 = peak_flops_for(device_kind, 0.0, dtype=compute_dtype)
+            self.quant_peak_flops = (narrow1 * self.n_devices
+                                     if narrow1 else None)
         ici1 = _lookup(ICI_BYTES_PER_SEC, device_kind)
         self.ici_bytes_per_sec = ici1 * self.n_devices if ici1 else None
         hbm1 = _lookup(HBM_BYTES_PER_SEC, device_kind)
@@ -224,6 +267,17 @@ class PerfAttribution:
         }
         if self.peak_flops:
             out["peak_tflops"] = round(self.peak_flops / 1e12, 2)
+        if self.compute_dtype not in ("bf16", "off"):
+            out["quant_compute"] = self.compute_dtype
+            if self.quant_peak_flops:
+                out[f"peak_tflops_{self.compute_dtype}"] = round(
+                    self.quant_peak_flops / 1e12, 2)
+                if self.peak_flops:
+                    # the low-precision FLOPs headroom: how much faster
+                    # the narrow MXU path is than the bf16 ceiling the
+                    # MFU denominator uses
+                    out["quant_peak_headroom"] = round(
+                        self.quant_peak_flops / self.peak_flops, 2)
         if self.ici_bytes_per_sec:
             out["ici_gbps"] = round(self.ici_bytes_per_sec / 1e9, 1)
         if cm.get("pipe_bubble_frac"):
@@ -290,6 +344,12 @@ class PerfAttribution:
         if flops and self.peak_flops:
             out["perf_mfu"] = round(flops / wall_s / self.peak_flops, 4)
             out["perf_tflops_per_sec"] = round(flops / wall_s / 1e12, 3)
+        if flops and self.quant_peak_flops:
+            # utilisation against the NARROW peak (always <= perf_mfu):
+            # the gap between the two is the unclaimed low-precision
+            # headroom the r17 quant path exists to spend
+            out["perf_mfu_vs_quant_peak"] = round(
+                flops / wall_s / self.quant_peak_flops, 4)
         if hbm:
             out["perf_hbm_gbps"] = round(hbm / wall_s / 1e9, 2)
             if self.hbm_bytes_per_sec:

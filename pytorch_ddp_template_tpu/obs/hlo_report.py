@@ -388,6 +388,151 @@ def pipe_evidence(hlo_text: str) -> dict[str, Any]:
     }
 
 
+#: narrow-dtype HLO spellings the quant walker recognises (int8 + the
+#: two fp8 formats; ``f8e4m3`` covers toolchains that drop the ``fn``)
+NARROW_DTYPES = ("s8", "f8e4m3fn", "f8e4m3", "f8e5m2")
+
+
+def _mentions_narrow(text: str) -> bool:
+    return any(f"{d}[" in text for d in NARROW_DTYPES)
+
+
+def _converts_to_narrow(text: str) -> bool:
+    """Whether any instruction in ``text`` is a convert whose RESULT is
+    narrow (the result shape sits between '=' and the opcode)."""
+    for line in text.splitlines():
+        rhs = line.partition("=")[2]
+        cidx = rhs.find(" convert(")
+        if cidx >= 0:
+            m = _SHAPE_RE.search(rhs[:cidx])
+            if m and m.group(1) in NARROW_DTYPES:
+                return True
+    return False
+
+
+def quant_evidence(hlo_text: str) -> dict[str, Any]:
+    """Low-precision compute witness (r17, ``--quant_compute``).
+
+    Three properties of the compiled step, all pure text analysis:
+
+    - ``narrow_dots`` — dot instructions fed by narrow operands: either
+      a narrow dtype inline in the operand list (a real narrow-MXU dot)
+      or an operand defined by a ``convert`` FROM a narrow value
+      (backends without a narrow MXU — this CPU host — upcast the
+      operands but the program still carries the narrow tensors, which
+      is what the HBM/wire savings ride on). ``quant_dots_present`` is
+      the headline boolean.
+    - ``narrow_ppermutes`` — collective-permutes whose payload is
+      narrow: the quantized ring wire (``--quant_compute`` ×
+      ``--tp_overlap``).
+    - the hoisting witness: a loop body whose narrow ppermute payloads
+      are NOT produced by an in-body convert-to-narrow (nor by a fusion
+      whose computation converts to narrow) quantized its payload ONCE
+      outside the loop (``hoisted_quant_ring_bodies`` — the
+      "scales not re-materialised per hop" tripwire); a body whose wire
+      tensor comes off such a convert re-quantizes per hop
+      (``requant_ring_bodies`` — the accumulator streams requant per
+      hop BY DESIGN, so only converts feeding the ppermute count).
+    """
+    comps = parse_computations(hlo_text)
+    # computation name -> its full instruction text, for resolving dot
+    # operands that are fusions wrapping the dequantizing converts (the
+    # CPU lowering fuses convert(s8→s32) into %convert_convert_fusion)
+    comp_text = {name.lstrip("%"): "\n".join(instrs)
+                 for name, instrs in comps}
+
+    def _operand_reaches_narrow(def_instr: str) -> bool:
+        rhs = def_instr.partition("=")[2]
+        if _mentions_narrow(rhs):
+            return True
+        for tok in _TOKEN_RE.findall(rhs):
+            text = comp_text.get(tok.lstrip("%"))
+            if text is not None and _mentions_narrow(text):
+                return True
+        return False
+
+    rows = []
+    narrow_dots = 0
+    narrow_pp = 0
+    for body_name, instrs in comps:
+        defs: dict[str, str] = {}
+        for s in instrs:
+            lhs, _, _rhs = s.partition("=")
+            names = _TOKEN_RE.findall(lhs)
+            if names:
+                defs[names[0]] = s
+        body_narrow_dots = 0
+        body_pp = 0
+        pp_payload_tokens: set[str] = set()
+        for s in instrs:
+            rhs = s.partition("=")[2]
+            if " dot(" in s or " convolution(" in s:
+                if _mentions_narrow(rhs):
+                    body_narrow_dots += 1
+                else:
+                    # narrow-MXU-less lowering: operands arrive through
+                    # converts/fusions FROM the narrow tensors
+                    for tok in _TOKEN_RE.findall(rhs):
+                        d = defs.get(tok, "")
+                        if d and _operand_reaches_narrow(d):
+                            body_narrow_dots += 1
+                            break
+            if (" collective-permute(" in s
+                    or " collective-permute-start(" in s) \
+                    and _mentions_narrow(s):
+                body_pp += 1
+                op = rhs.find("collective-permute")
+                pp_payload_tokens.update(_TOKEN_RE.findall(rhs[op:]))
+        # per-hop payload requant witness: the wire tensor is produced
+        # INSIDE the body by a convert whose RESULT is narrow (result
+        # shape sits between '=' and the opcode), or by a fusion whose
+        # computation carries such a convert (this CPU lowering fuses
+        # the requant). Converts-to-narrow NOT feeding a ppermute are
+        # the accumulator streams — by design, never counted.
+        converts_to_narrow = 0
+        for tok in sorted(pp_payload_tokens):
+            d = defs.get(tok)
+            if not d:
+                continue
+            drhs = d.partition("=")[2]
+            cidx = drhs.find(" convert(")
+            fidx = drhs.find(" fusion(")
+            opidx = cidx if cidx >= 0 else fidx
+            if opidx < 0:
+                continue
+            m = _SHAPE_RE.search(drhs[:opidx])
+            if not (m and m.group(1) in NARROW_DTYPES):
+                continue
+            if cidx >= 0:
+                converts_to_narrow += 1
+            else:
+                for ftok in _TOKEN_RE.findall(drhs[opidx:]):
+                    text = comp_text.get(ftok.lstrip("%"))
+                    if text is not None and _converts_to_narrow(text):
+                        converts_to_narrow += 1
+                        break
+        narrow_dots += body_narrow_dots
+        narrow_pp += body_pp
+        if body_narrow_dots or body_pp:
+            rows.append({
+                "computation": body_name.lstrip("%"),
+                "narrow_dots": body_narrow_dots,
+                "narrow_ppermutes": body_pp,
+                "converts_to_narrow": converts_to_narrow,
+            })
+    pp_bodies = [r for r in rows if r["narrow_ppermutes"] > 0]
+    hoisted = [r for r in pp_bodies if r["converts_to_narrow"] == 0]
+    return {
+        "bodies": rows,
+        "narrow_dots": narrow_dots,
+        "narrow_ppermutes": narrow_pp,
+        "narrow_ring_bodies": len(pp_bodies),
+        "hoisted_quant_ring_bodies": len(hoisted),
+        "requant_ring_bodies": len(pp_bodies) - len(hoisted),
+        "quant_dots_present": narrow_dots >= 1,
+    }
+
+
 def _shape_bytes(instr: str, op: str) -> int:
     """Estimated result bytes of a collective instruction: the last
     ``dtype[dims]`` group BEFORE the opcode token (for the plain
@@ -488,6 +633,7 @@ def schedule_report(hlo_text: str) -> dict[str, Any]:
                 composed["composed_overlap_independent"],
         },
         "pipe": pipe_evidence(hlo_text),
+        "quant": quant_evidence(hlo_text),
     }
 
 
@@ -575,4 +721,36 @@ def check_overlap_expectations(report: dict[str, Any], config: Any,
                 "not survived compilation — the deferred dw wave that "
                 "fills the drain region is missing"
             )
+    # r17 quant tripwire: a --quant_compute run must actually carry
+    # narrow-dtype dots (compute quantized), and composed with the TP
+    # rings the ppermute payloads must be narrow with the quantization
+    # hoisted out of at least one ring loop (quantize once per chunk —
+    # per-hop re-quantization of every stream means the narrow wire is
+    # paying a full requant tax it was designed to avoid)
+    quant_mode = getattr(config, "quant_compute", "off")
+    if quant_mode != "off":
+        qe = report.get("quant", {})
+        if not qe.get("quant_dots_present", False):
+            warns.append(
+                f"--quant_compute {quant_mode} is on but the compiled "
+                "step carries NO narrow-dtype dots: the low-precision "
+                "path has not survived compilation — every matmul is "
+                "running wide again"
+            )
+        if getattr(config, "tp_overlap", False) and model > 1:
+            if qe.get("narrow_ppermutes", 0) < 1:
+                warns.append(
+                    f"--quant_compute {quant_mode} × --tp_overlap is on "
+                    "but no collective-permute carries a narrow payload: "
+                    "the ring wire is wide — the quantized ring kernels "
+                    "are not in the compiled program"
+                )
+            elif qe.get("hoisted_quant_ring_bodies", 0) < 1:
+                warns.append(
+                    f"--quant_compute {quant_mode} × --tp_overlap: every "
+                    "narrow-ppermute ring body re-quantizes inside the "
+                    "loop — the once-per-chunk quantization hoisting has "
+                    "not survived compilation "
+                    f"(requant_bodies={qe.get('requant_ring_bodies', 0)})"
+                )
     return warns

@@ -1,0 +1,372 @@
+"""Low-precision compute primitives (``--quant_compute {off,int8,fp8}``):
+per-channel scaled int8/fp8 matmuls for the scanned transformer stack and
+the ring collective matmuls.
+
+r9 proved quantized *communication* pays (int8 wire at 0.254x fp32 with
+error feedback recovering the trajectory); this module is the *compute*
+half of the same economics: the dots themselves run on narrow operands,
+so the MXU int8/fp8 paths (2x the bf16 peak on every TPU generation that
+has them — ``obs/attribution.py``'s per-dtype tables) and HBM bandwidth
+both get the 2-4x, and — composed with the decomposed TP rings
+(``parallel/collective_matmul.py``) — the ppermutes carry the narrow
+tensor + its scales, so wire and FLOPs shrink together (Wang et al.,
+ASPLOS'23 decomposition applied to a quantized operand).
+
+Numerics follow established low-precision-training practice (Micikevicius
+et al., *FP8 Formats for Deep Learning*): **master weights stay fp32** in
+``TrainState`` and the optimizer updates them directly — quantization is
+re-derived from the masters every step, so rounding error never
+accumulates across steps (the reason deterministic round-to-nearest is
+safe here where the r9 gradient wire needed stochastic rounding + error
+feedback: a wire error compounds into the trajectory, a compute error is
+re-sampled from the fp32 truth each step). Scaling is symmetric per
+*channel* of the contraction:
+
+- activations: one scale per row over the contraction axis
+  (``absmax/QMAX``), so the scale factors out of the dot exactly;
+- weights: one scale per output channel (absmax over the contraction
+  dims), factoring out on the other side — the scaled dot
+  ``(a_q s_a) @ (w_q s_w)`` is algebraically exact given the quantized
+  operands; the only error is the rounding of the operands themselves.
+
+int8 accumulates in int32 (``preferred_element_type``), fp8 (e4m3 values,
+e5m2 cotangents — the standard fwd/bwd split) in f32. The fp8 dtypes are
+this jaxlib's native ``float8_e4m3fn``/``float8_e5m2``; backends without
+a narrow MXU (this CPU host) upcast the operands in XLA — the program
+still *carries* narrow-dtype dots (the ``--hlo_report`` quant tripwire's
+witness) and the wire/HBM savings are real, only the FLOPs win needs the
+real MXU.
+
+:func:`quant_dense` is the drop-in replacement for the block matmuls
+(``models/transformer.py`` routes fc1/fc2/qkv/out through it under
+``--quant_compute``, with ``_DenseParams`` twins keeping the param tree
+bit-interchangeable with the default path): a ``jax.custom_vjp`` whose
+backward also runs narrow — dx and dw quantize their operands over the
+respective contraction axes (both factorize per-channel), with fp8
+cotangents in e5m2.
+
+:func:`quant_matmul_pallas` is the fused dequant→dot→requant kernel
+(``ops/flash.py`` is the in-tree exemplar): narrow operands stream from
+HBM, the accumulator lives in VMEM scratch, and the per-channel scales
+apply once at the final K tile — the dequantized f32 tensor never exists
+in HBM, so the path wins memory bandwidth as well as FLOPs. Following
+the FLASH_BWD convention, the XLA lowering is the default everywhere
+(``QUANT_IMPL=pallas`` opts in; interpret mode keeps the kernel
+continuously validated on CPU CI) until the real-Mosaic parity record
+lands via ``tools/tpu_followup.sh legs_r17``.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+#: the --quant_compute surface; "off" must leave the default path
+#: bit-untouched (pinned by test and the BENCH_MODE=quant parity leg)
+QUANT_COMPUTE_MODES = ("off", "int8", "fp8")
+
+#: fp8 value/weight dtype (3 mantissa bits, the fwd format) and cotangent
+#: dtype (2 mantissa bits, 5 exponent bits — gradients need range more
+#: than precision; the standard fwd/bwd split)
+FP8_FWD_DTYPE = jnp.float8_e4m3fn
+FP8_BWD_DTYPE = jnp.float8_e5m2
+
+#: largest finite value of each narrow format (the symmetric-scale
+#: denominator): int8 uses 127, e4m3fn saturates at 448, e5m2 at 57344
+QMAX = {"int8": 127.0, "fp8": 448.0, "fp8_grad": 57344.0}
+
+
+def _norm_axes(axes, ndim: int) -> tuple[int, ...]:
+    if isinstance(axes, int):
+        axes = (axes,)
+    return tuple(a % ndim for a in axes)
+
+
+def quantize_channel(x: jax.Array, mode: str, axes=-1, *,
+                     grad: bool = False,
+                     key: jax.Array | None = None
+                     ) -> tuple[jax.Array, jax.Array]:
+    """Symmetric per-channel quantization of ``x`` over ``axes``.
+
+    ``axes`` are the contraction axes the scale is shared over (absmax
+    reduced there, keepdims) — one scale per remaining "channel", which
+    is exactly the granularity that factors out of a dot contracting
+    those axes. Returns ``(q, scale)`` with ``scale`` f32 and all-zero
+    channels pinned to scale 1.0 (dequant stays exact zeros).
+
+    ``mode``: ``int8`` (stochastic rounding when ``key`` is given —
+    the ``parallel/compress.py`` recipe — else round-to-nearest) or
+    ``fp8`` (hardware round-to-nearest-even via the dtype convert;
+    ``grad=True`` selects e5m2 for cotangents).
+    """
+    if mode not in ("int8", "fp8"):
+        raise ValueError(
+            f"quantize_channel: unknown mode {mode!r}; expected int8 | fp8 "
+            f"(the 'off' mode never reaches the quantizers)")
+    axes = _norm_axes(axes, x.ndim)
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=axes, keepdims=True)
+    if mode == "int8":
+        scale = jnp.where(amax > 0, amax / QMAX["int8"], 1.0)
+        y = xf / scale
+        if key is not None:
+            u = jax.random.uniform(key, y.shape, jnp.float32)
+            y = jnp.floor(y + u)
+        else:
+            y = jnp.round(y)
+        q = jnp.clip(y, -127.0, 127.0).astype(jnp.int8)
+    else:
+        qmax = QMAX["fp8_grad" if grad else "fp8"]
+        dt = FP8_BWD_DTYPE if grad else FP8_FWD_DTYPE
+        scale = jnp.where(amax > 0, amax / qmax, 1.0)
+        q = (xf / scale).astype(dt)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize(q: jax.Array, scale: jax.Array) -> jax.Array:
+    """Inverse of :func:`quantize_channel` (broadcasting scale)."""
+    return q.astype(jnp.float32) * scale
+
+
+def roundtrip_rel_error_bound(mode: str, *, grad: bool = False) -> float:
+    """Documented per-channel relative error bound of one
+    quantize→dequantize round trip, relative to the channel's absmax:
+    half a quantum for round-to-nearest int8 (1/254), one e4m3/e5m2 ulp
+    at the top of a binade for fp8 (2^-3 / 2^-2 relative spacing — the
+    absolute error is bounded by ulp(absmax)). Pinned by unit test and
+    the BENCH_MODE=quant roundtrip leg.
+    """
+    if mode == "int8":
+        return 0.5 / QMAX["int8"]
+    return 2.0 ** (-2 if grad else -3)
+
+
+def quant_dot(aq: jax.Array, a_scale: jax.Array, wq: jax.Array,
+              w_scale: jax.Array, *, out_dtype=jnp.float32) -> jax.Array:
+    """Scaled narrow dot ``(..., K) @ (K, N) -> (..., N)``.
+
+    ``aq`` quantized per row over its last axis (``a_scale``
+    ``(..., 1)``); ``wq`` per output channel (``w_scale`` ``(1, N)``).
+    int8 operands accumulate in int32 on the MXU int8 path; fp8 in f32.
+    The scales apply ONCE to the accumulator — the fused dequant.
+    """
+    pet = jnp.int32 if aq.dtype == jnp.int8 else jnp.float32
+    acc = lax.dot_general(aq, wq, (((aq.ndim - 1,), (0,)), ((), ())),
+                          preferred_element_type=pet)
+    return (acc.astype(jnp.float32) * a_scale * w_scale).astype(out_dtype)
+
+
+# -- Pallas fused kernel ---------------------------------------------------
+
+def _quant_matmul_kernel(aq_ref, wq_ref, as_ref, ws_ref, o_ref, acc_ref, *,
+                         k_blocks: int, is_int8: bool):
+    k = pl.program_id(2)  # K tile (sequential)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    a = aq_ref[...]
+    w = wq_ref[...]
+    if is_int8:
+        # int32 accumulation: the MXU int8 path's native accumulator
+        acc_ref[...] += lax.dot_general(
+            a.astype(jnp.int32), w.astype(jnp.int32),
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32)
+    else:
+        acc_ref[...] += lax.dot_general(
+            a.astype(jnp.float32), w.astype(jnp.float32),
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(k == k_blocks - 1)
+    def _finalize():
+        # fused dequant: scales hit the accumulator exactly once, and the
+        # f32 tensor never round-trips through HBM
+        out = acc_ref[...].astype(jnp.float32)
+        out = out * as_ref[...] * ws_ref[...]
+        o_ref[...] = out.astype(o_ref.dtype)
+
+
+try:  # pallas availability mirrors ops/flash.py
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _PALLAS = True
+except Exception:  # noqa: BLE001 - environments without pallas
+    _PALLAS = False
+
+
+def quant_matmul_pallas(aq: jax.Array, a_scale: jax.Array, wq: jax.Array,
+                        w_scale: jax.Array, *, out_dtype=jnp.float32,
+                        block_m: int = 128, block_n: int = 128,
+                        block_k: int = 128,
+                        interpret: bool | None = None) -> jax.Array:
+    """Fused dequant→dot→requant tiled matmul: ``(M, K) @ (K, N)``.
+
+    Narrow operands stream tile-by-tile; the accumulator (int32 for
+    int8, f32 for fp8) lives in VMEM scratch across the sequential K
+    tiles; the per-channel scales apply once at the last tile and the
+    output stores in ``out_dtype`` — HBM only ever sees narrow inputs
+    and the final (bf16/f32) tiles. ``interpret`` defaults to
+    off-TPU detection like ``ops.flash.flash_attention``.
+    """
+    if not _PALLAS:
+        raise RuntimeError("pallas unavailable on this jax build; use the "
+                           "XLA lowering (quant_dot)")
+    m, k = aq.shape
+    k2, n = wq.shape
+    if k != k2:
+        raise ValueError(f"quant_matmul_pallas: contraction mismatch "
+                         f"{aq.shape} @ {wq.shape}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bm, bn, bk = (math.gcd(m, block_m), math.gcd(n, block_n),
+                  math.gcd(k, block_k))
+    if not interpret and min(bm, bn, bk) < 8:
+        raise ValueError(
+            f"quant_matmul_pallas: dims ({m},{k},{n}) with blocks "
+            f"({block_m},{block_n},{block_k}) fit only a "
+            f"{min(bm, bn, bk)}-wide tile; pad to MXU-friendly multiples "
+            "or use the XLA lowering")
+    grid = (m // bm, n // bn, k // bk)
+    is_int8 = aq.dtype == jnp.int8
+    acc_dtype = jnp.int32 if is_int8 else jnp.float32
+    kernel = functools.partial(_quant_matmul_kernel, k_blocks=grid[2],
+                               is_int8=is_int8)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+            pl.BlockSpec((bm, 1), lambda i, j, kk: (i, 0)),
+            pl.BlockSpec((1, bn), lambda i, j, kk: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), acc_dtype)],
+        compiler_params=CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(aq, wq, a_scale, w_scale)
+
+
+if _PALLAS:
+    CompilerParams = (getattr(pltpu, "CompilerParams", None)
+                      or pltpu.TPUCompilerParams)
+
+
+_impl_logged: set[str] = set()
+
+
+def quant_impl() -> str:
+    """Active lowering for the quantized dense dots, read at TRACE time
+    (the FLASH_BWD convention): ``QUANT_IMPL=pallas`` opts into the
+    fused kernel (interpret mode off-TPU — how CPU CI validates it);
+    default ``xla`` everywhere until the real-Mosaic parity record lands
+    (tools/tpu_followup.sh legs_r17). A typo'd override fails loudly."""
+    impl = os.environ.get("QUANT_IMPL", "xla")
+    if impl not in ("xla", "pallas"):
+        raise ValueError(f"QUANT_IMPL={impl!r}: expected 'xla' or 'pallas'")
+    if impl not in _impl_logged:
+        _impl_logged.add(impl)
+        from ..utils import get_logger
+
+        get_logger(__name__).info(
+            "quantized-dense lowering selected (trace-time; set QUANT_IMPL "
+            "before first use or jax.clear_caches() to change)",
+            {"impl": impl},
+        )
+    return impl
+
+
+# -- the differentiable dense op -------------------------------------------
+
+def _flat2(x: jax.Array, n_axes: int) -> tuple[jax.Array, tuple[int, ...]]:
+    """Collapse to 2D: leading batch dims x flattened contraction dims."""
+    batch_shape = x.shape[: x.ndim - n_axes]
+    return x.reshape(math.prod(batch_shape) if batch_shape else 1, -1), \
+        batch_shape
+
+
+def _qdense_fwd_math(x2, w2, mode, out_dtype, impl):
+    xq, xs = quantize_channel(x2, mode, axes=-1)
+    wq, ws = quantize_channel(w2, mode, axes=0)
+    ws = ws.reshape(1, -1)
+    if impl == "pallas":
+        return quant_matmul_pallas(xq, xs, wq, ws, out_dtype=out_dtype)
+    return quant_dot(xq, xs, wq, ws, out_dtype=out_dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _qdense2(x2, w2, mode, out_dtype, impl):
+    return _qdense_fwd_math(x2, w2, mode, out_dtype, impl)
+
+
+def _qdense2_fwd(x2, w2, mode, out_dtype, impl):
+    return _qdense_fwd_math(x2, w2, mode, out_dtype, impl), (x2, w2)
+
+
+def _qdense2_bwd(mode, out_dtype, impl, res, gy):
+    """Narrow backward: dx = gy @ w^T with gy quantized per row over N
+    (e5m2 under fp8) and w per input-channel over N; dw = x^T @ gy with
+    both quantized per channel over the batch axis M — every contraction
+    carries per-channel scales on exactly the contracted axis, so the
+    scaled dots are algebraically exact given the quantized operands."""
+    x2, w2 = res
+    gy = gy.astype(jnp.float32)
+    # dx: contract N — gy rows scaled over N, w^T columns (= w input
+    # channels) scaled over N
+    gq, gs = quantize_channel(gy, mode, axes=-1, grad=True)
+    wTq, wTs = quantize_channel(w2.T, mode, axes=0)   # (N, K), scale (1, K)
+    dx = quant_dot(gq, gs, wTq, wTs, out_dtype=jnp.float32)
+    # dw: contract M
+    xq2, xs2 = quantize_channel(x2, mode, axes=0)     # (M, K), scale (1, K)
+    gq2, gs2 = quantize_channel(gy, mode, axes=0, grad=True)  # scale (1, N)
+    pet = jnp.int32 if xq2.dtype == jnp.int8 else jnp.float32
+    dw = lax.dot_general(xq2, gq2, (((0,), (0,)), ((), ())),
+                         preferred_element_type=pet).astype(jnp.float32)
+    dw = dw * xs2.reshape(-1, 1) * gs2.reshape(1, -1)
+    return dx.astype(x2.dtype), dw.astype(w2.dtype)
+
+
+_qdense2.defvjp(_qdense2_fwd, _qdense2_bwd)
+
+
+def quant_dense(x: jax.Array, kernel: jax.Array, bias: jax.Array,
+                n_axes: int, mode: str, dtype=jnp.float32) -> jax.Array:
+    """Low-precision twin of ``models/transformer._plain_dense``:
+    DenseGeneral's contraction run as a per-channel-scaled narrow dot
+    (forward AND backward), bias added in ``dtype``. ``kernel``/``bias``
+    are the fp32 masters from the ``_DenseParams`` twins — quantization
+    is re-derived from them at every call, so no rounding error ever
+    accumulates into the stored weights."""
+    if mode not in ("int8", "fp8"):
+        raise ValueError(f"quant_dense: unknown mode {mode!r}")
+    x2, batch_shape = _flat2(x, n_axes)
+    w2 = kernel.reshape(x2.shape[-1], -1)
+    y2 = _qdense2(x2, w2.astype(jnp.float32), mode, jnp.float32,
+                  quant_impl())
+    feat_shape = kernel.shape[n_axes:]
+    y = y2.reshape(*batch_shape, *feat_shape)
+    return (y + bias.astype(jnp.float32)).astype(dtype)
+
+
+# -- accounting ------------------------------------------------------------
+
+def quant_itemsize(mode: str) -> float:
+    """Wire/HBM bytes per element of a quantized payload (both int8 and
+    the fp8 formats are one byte; 'off' is the fp32 4)."""
+    return 4.0 if mode == "off" else 1.0
+
+
+def quant_scale_overhead(channel: int) -> float:
+    """Extra f32-scale bytes per payload element for per-channel scaling
+    with ``channel`` elements sharing one scale (4/channel)."""
+    return 4.0 / max(int(channel), 1)

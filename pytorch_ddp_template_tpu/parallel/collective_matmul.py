@@ -205,6 +205,249 @@ def _mm_rs_local(h: jax.Array, w: jax.Array) -> jax.Array:
     return acc
 
 
+# -- quantized ring kernels (--quant_compute int8|fp8, ops/quant.py) -------
+#
+# The decomposed rings are where quantized *compute* compounds with
+# quantized *wire* (the ROADMAP's "quantize once per chunk and the ring
+# rotates the narrow tensor"): each payload is quantized ONCE before the
+# loop — the ppermute then carries the int8/fp8 tensor plus its f32
+# per-row scales (4/E overhead per element), and the partial dots consume
+# the narrow operands directly where the per-channel scales factor out of
+# the contraction (forward column/row partials, backward dx/dh). Running
+# accumulators (the fwd row reduce-scatter, the bwd column dx) cannot stay
+# narrow across hops without per-hop requantization — they carry
+# (q, scale) and dequant→add→requant each step (bounded by one quantum
+# per hop; re-derived from fp32 masters next step, so nothing
+# accumulates across steps). Contractions whose scale axis is the
+# *batch* dims (the dw partials against a rotated chunk) dequantize
+# first — a per-(b,t) scale cannot factor out of a (b,t) contraction;
+# the wire stays narrow either way. --hlo_report's quant tripwire pins
+# the hoisting: at least one narrow-ppermute loop body must contain NO
+# convert-to-narrow (the once-per-chunk witness).
+
+def _quantize_for_ring(x: jax.Array, quant: str, *, axes=-1,
+                       grad: bool = False):
+    from ..ops.quant import quantize_channel
+
+    return quantize_channel(x, quant, axes=axes, grad=grad)
+
+
+def _deq(q: jax.Array, s: jax.Array) -> jax.Array:
+    from ..ops.quant import dequantize
+
+    return dequantize(q, s)
+
+
+def _col_math_q(x_c, kernels, biases, quant):
+    """Quantized all-gather-matmul: the held chunk is quantized once
+    (per-token-row over E), the weights once (per output channel over E);
+    the ring rotates (q, scale) and every partial dot runs narrow."""
+    from ..ops.quant import quant_dot
+
+    n = _ring_size()
+    my = lax.axis_index(MODEL_AXIS)
+    perm = ring_perm(n)
+    sizes = [math.prod(k.shape[1:]) for k in kernels]
+    wcat = jnp.concatenate(
+        [k.reshape(k.shape[0], -1) for k in kernels], axis=1)
+    wq, ws = _quantize_for_ring(wcat, quant, axes=0)   # scale (1, Fl)
+    xq, xs = _quantize_for_ring(x_c, quant, axes=-1)   # scale (B, t, 1)
+    b, t, _ = x_c.shape
+    out = jnp.zeros((b, n * t, wcat.shape[-1]),
+                    jnp.result_type(x_c.dtype, wcat.dtype))
+
+    def body(carry, r):
+        out, xq, xs = carry
+        src = ring_source(my, r, n)
+        part = quant_dot(xq, xs, wq, ws, out_dtype=out.dtype)
+        out = lax.dynamic_update_slice_in_dim(out, part, src * t, axis=1)
+        # the hop carries the NARROW tensor + its scales — both are
+        # loop-carried state, independent of this step's dot
+        xq = lax.ppermute(xq, MODEL_AXIS, perm)
+        xs = lax.ppermute(xs, MODEL_AXIS, perm)
+        return (out, xq, xs), None
+
+    (out, _, _), _ = lax.scan(body, (out, xq, xs), jnp.arange(n))
+    outs, off = [], 0
+    for k, bias, sz in zip(kernels, biases, sizes):
+        y = out[..., off:off + sz] + bias.reshape(-1)
+        outs.append(y.reshape(*y.shape[:-1], *k.shape[1:]))
+        off += sz
+    return tuple(outs)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _col_local_q(x_c, kernels, biases, quant):
+    return _col_math_q(x_c, kernels, biases, quant)
+
+
+def _col_local_q_fwd(x_c, kernels, biases, quant):
+    return _col_math_q(x_c, kernels, biases, quant), (x_c, kernels)
+
+
+def _col_local_q_bwd(quant, res, gys):
+    """Quantized mirror of ``_col_local_bwd``: the cotangent is quantized
+    once (e5m2 under fp8) and its dx partials run narrow against the
+    per-input-channel-scaled ``w^T``; the dx reduce-scatter accumulator
+    rotates narrow with a per-hop requant; the saved input chunk rotates
+    narrow and dequantizes only for its dw partial (a (b,t)-contraction
+    no per-row scale factors out of). Weight/bias cotangents leave the
+    region per-shard exactly as in the fp32 kernel."""
+    from ..ops.quant import quant_dot
+
+    x_c, kernels = res
+    n = _ring_size()
+    sizes = [math.prod(k.shape[1:]) for k in kernels]
+    wcat = jnp.concatenate(
+        [k.reshape(k.shape[0], -1) for k in kernels], axis=1)
+    gcat = jnp.concatenate(
+        [g.reshape(*g.shape[:2], -1) for g in gys], axis=-1)
+    my = lax.axis_index(MODEL_AXIS)
+    perm = ring_perm(n)
+    t = x_c.shape[1]
+    # hoisted quantizations: cotangent rows over Fl (grad dtype), w^T
+    # input channels over Fl, the saved chunk rows over E (wire payload)
+    gq, gs = _quantize_for_ring(gcat, quant, axes=-1, grad=True)
+    wTq, wTs = _quantize_for_ring(
+        jnp.swapaxes(wcat, 0, 1), quant, axes=0)    # (Fl, E), scale (1, E)
+    cq, cs = _quantize_for_ring(x_c, quant, axes=-1)
+    dxq, dxs = _quantize_for_ring(
+        jnp.zeros(x_c.shape, jnp.float32), quant, axes=-1, grad=True)
+    dw = jnp.zeros((wcat.shape[0], wcat.shape[1]), jnp.float32)
+
+    def body(carry, r):
+        dxq, dxs, cq, cs, dw = carry
+        # dx: rotate-at-start of the NARROW accumulator, then
+        # dequant → add this chunk's narrow partial → requant
+        dxq = lax.ppermute(dxq, MODEL_AXIS, perm)
+        dxs = lax.ppermute(dxs, MODEL_AXIS, perm)
+        c = (my - r - 1) % n
+        g_c = lax.dynamic_slice_in_dim(gq, c * t, t, axis=1)
+        g_c_s = lax.dynamic_slice_in_dim(gs, c * t, t, axis=1)
+        part = quant_dot(g_c, g_c_s, wTq, wTs, out_dtype=jnp.float32)
+        dxq, dxs = _quantize_for_ring(_deq(dxq, dxs) + part, quant,
+                                      axes=-1, grad=True)
+        # dw: the narrow chunk rotates (rotate-after-consume); its dw
+        # partial contracts (b, t), so it dequantizes for the dot
+        src = ring_source(my, r, n)
+        g_src = lax.dynamic_slice_in_dim(gcat, src * t, t, axis=1)
+        dw = dw + lax.dot_general(
+            _deq(cq, cs), g_src.astype(jnp.float32),
+            (((0, 1), (0, 1)), ((), ())),
+            preferred_element_type=jnp.float32)
+        cq = lax.ppermute(cq, MODEL_AXIS, perm)
+        cs = lax.ppermute(cs, MODEL_AXIS, perm)
+        return (dxq, dxs, cq, cs, dw), None
+
+    (dxq, dxs, _, _, dw), _ = lax.scan(
+        body, (dxq, dxs, cq, cs, dw), jnp.arange(n))
+    dx = _deq(dxq, dxs)
+    dks, dbs, off = [], [], 0
+    for k, g, sz in zip(kernels, gys, sizes):
+        dks.append(dw[:, off:off + sz].reshape(k.shape).astype(k.dtype))
+        dbs.append(jnp.sum(g.astype(jnp.float32), axis=(0, 1))
+                   .astype(g.dtype))
+        off += sz
+    return dx.astype(x_c.dtype), tuple(dks), tuple(dbs)
+
+
+_col_local_q.defvjp(_col_local_q_fwd, _col_local_q_bwd)
+
+
+def _row_math_q(h_l, w_l, b, quant):
+    """Quantized matmul-reduce-scatter: operands quantized once (rows
+    over K, output channels over K), partial dots narrow, and the
+    rotating accumulator carried as (q, scale) with a per-hop requant —
+    the psum never exists, and neither does a wide wire."""
+    from ..ops.quant import quant_dot
+
+    n = _ring_size()
+    my = lax.axis_index(MODEL_AXIS)
+    perm = ring_perm(n)
+    h2 = h_l.reshape(*h_l.shape[:2], -1)
+    w2 = w_l.reshape(-1, w_l.shape[-1])
+    t = h2.shape[1] // n
+    hq, hs = _quantize_for_ring(h2, quant, axes=-1)   # (B, nt, 1)
+    wq, ws = _quantize_for_ring(w2, quant, axes=0)    # (1, E)
+    accq, accs = _quantize_for_ring(
+        jnp.zeros((h2.shape[0], t, w2.shape[-1]), jnp.float32), quant,
+        axes=-1)
+
+    def body(carry, r):
+        accq, accs = carry
+        # rotate FIRST (narrow accumulator + scales are the only
+        # loop-carried ppermute operands), then dequant→add→requant
+        accq = lax.ppermute(accq, MODEL_AXIS, perm)
+        accs = lax.ppermute(accs, MODEL_AXIS, perm)
+        c = (my - r - 1) % n
+        h_c = lax.dynamic_slice_in_dim(hq, c * t, t, axis=1)
+        h_c_s = lax.dynamic_slice_in_dim(hs, c * t, t, axis=1)
+        part = quant_dot(h_c, h_c_s, wq, ws, out_dtype=jnp.float32)
+        accq, accs = _quantize_for_ring(_deq(accq, accs) + part, quant,
+                                        axes=-1)
+        return (accq, accs), None
+
+    (accq, accs), _ = lax.scan(body, (accq, accs), jnp.arange(n))
+    return (_deq(accq, accs) + b).astype(
+        jnp.result_type(h_l.dtype, w_l.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _row_local_q(h_l, w_l, b, quant):
+    return _row_math_q(h_l, w_l, b, quant)
+
+
+def _row_local_q_fwd(h_l, w_l, b, quant):
+    return _row_math_q(h_l, w_l, b, quant), (h_l, w_l)
+
+
+def _row_local_q_bwd(quant, res, g):
+    """Quantized mirror of ``_row_local_bwd``: the seq-sharded cotangent
+    chunk is quantized once (e5m2 under fp8, per row over E) and rotates
+    narrow; its dh partials run narrow against the per-K-channel-scaled
+    ``w^T``; the dw partial dequantizes the held chunk (a (b,t)
+    contraction). One rotation, two transposed collectives, narrow
+    wire."""
+    from ..ops.quant import quant_dot
+
+    h_l, w_l = res
+    n = _ring_size()
+    h2 = h_l.reshape(*h_l.shape[:2], -1)
+    w2 = w_l.reshape(-1, w_l.shape[-1])
+    my = lax.axis_index(MODEL_AXIS)
+    perm = ring_perm(n)
+    t = g.shape[1]
+    gq, gs = _quantize_for_ring(g.astype(jnp.float32), quant, axes=-1,
+                                grad=True)
+    wTq, wTs = _quantize_for_ring(
+        jnp.swapaxes(w2, 0, 1), quant, axes=0)      # (E, K), scale (1, K)
+    dh = jnp.zeros(h2.shape, jnp.float32)
+    dw = jnp.zeros(w2.shape, jnp.float32)
+
+    def body(carry, r):
+        dh, gq, gs, dw = carry
+        src = ring_source(my, r, n)
+        part = quant_dot(gq, gs, wTq, wTs, out_dtype=jnp.float32)
+        dh = lax.dynamic_update_slice_in_dim(dh, part, src * t, axis=1)
+        h_src = lax.dynamic_slice_in_dim(h2, src * t, t, axis=1)
+        dw = dw + lax.dot_general(
+            h_src.astype(jnp.float32), _deq(gq, gs),
+            (((0, 1), (0, 1)), ((), ())),
+            preferred_element_type=jnp.float32)
+        gq = lax.ppermute(gq, MODEL_AXIS, perm)
+        gs = lax.ppermute(gs, MODEL_AXIS, perm)
+        return (dh, gq, gs, dw), None
+
+    (dh, _, _, dw), _ = lax.scan(body, (dh, gq, gs, dw), jnp.arange(n))
+    db = jnp.sum(g.astype(jnp.float32), axis=(0, 1))
+    return (dh.reshape(h_l.shape).astype(h_l.dtype),
+            dw.reshape(w_l.shape).astype(w_l.dtype),
+            db.astype(g.dtype))
+
+
+_row_local_q.defvjp(_row_local_q_fwd, _row_local_q_bwd)
+
+
 # -- column op: y_i = AG(x) @ w_i + b_i (fc1 / fused qkv) ------------------
 
 def _col_math(x_c, kernels, biases):
@@ -282,9 +525,18 @@ def _col_local_bwd(res, gys):
 _col_local.defvjp(_col_local_fwd, _col_local_bwd)
 
 
+def _check_quant(quant: str) -> None:
+    from ..ops.quant import QUANT_COMPUTE_MODES
+
+    if quant not in QUANT_COMPUTE_MODES:
+        raise ValueError(
+            f"unknown quant_compute mode {quant!r}; expected one of "
+            f"{QUANT_COMPUTE_MODES}")
+
+
 def tp_column_dense(x: jax.Array, kernels: Sequence[jax.Array],
-                    biases: Sequence[jax.Array], mesh: Mesh,
-                    ) -> list[jax.Array]:
+                    biases: Sequence[jax.Array], mesh: Mesh, *,
+                    quant: str = "off") -> list[jax.Array]:
     """Ring-overlapped column-split dense layer(s).
 
     ``x``: ``(B, T, E)``, seq-sharded over ``model`` (dim 1). Each
@@ -295,7 +547,13 @@ def tp_column_dense(x: jax.Array, kernels: Sequence[jax.Array],
     Passing several kernels fuses them into ONE ring: the activation
     rotates once and every projection's partial dot consumes the same held
     chunk (the fused-qkv path — a third of the separate-rings wire).
+
+    ``quant`` (``--quant_compute``): ``int8``/``fp8`` runs the quantized
+    ring kernel — the chunk is quantized once before the loop, the
+    ppermute carries the narrow tensor + per-row scales, and the partial
+    dots consume the narrow operands (``ops/quant.py``).
     """
+    _check_quant(quant)
     n = mesh.shape[MODEL_AXIS]
     ba = _batch_axis(mesh)
     _check_divisible("sequence length", x.shape[1], n)
@@ -308,7 +566,9 @@ def tp_column_dense(x: jax.Array, kernels: Sequence[jax.Array],
                     for k in kernels)
     y_specs = tuple(P(ba, None, MODEL_AXIS, *([None] * (k.ndim - 2)))
                     for k in kernels)
-    out = shard_map(_col_local, mesh=mesh,
+    fn = (_col_local if quant == "off"
+          else lambda x_c, ks, bs: _col_local_q(x_c, ks, bs, quant))
+    out = shard_map(fn, mesh=mesh,
                     in_specs=(x_spec, k_specs, b_specs),
                     out_specs=y_specs, check_vma=False)(
         x, tuple(kernels), tuple(biases))
@@ -316,14 +576,18 @@ def tp_column_dense(x: jax.Array, kernels: Sequence[jax.Array],
 
 
 def tp_column_dense_local(x_c: jax.Array, kernels: Sequence[jax.Array],
-                          biases: Sequence[jax.Array]) -> list[jax.Array]:
+                          biases: Sequence[jax.Array], *,
+                          quant: str = "off") -> list[jax.Array]:
     """Local (per-shard) form of :func:`tp_column_dense` for callers
     ALREADY inside a ``shard_map`` region that includes the ``model``
     axis (the ddp×tp composed schedule, ``parallel/schedule.py``): the
     same ring kernel, same custom_vjp backward, no second region. Inputs
     are the per-shard chunks — ``x_c`` the held seq chunk ``(B_l, t,
     E)``, kernels/biases the local feature shards."""
-    return list(_col_local(x_c, tuple(kernels), tuple(biases)))
+    _check_quant(quant)
+    if quant == "off":
+        return list(_col_local(x_c, tuple(kernels), tuple(biases)))
+    return list(_col_local_q(x_c, tuple(kernels), tuple(biases), quant))
 
 
 # -- row op: y = RS(h @ w) + b (fc2 / out projection) ----------------------
@@ -389,7 +653,7 @@ _row_local.defvjp(_row_local_fwd, _row_local_bwd)
 
 
 def tp_row_dense(h: jax.Array, kernel: jax.Array, bias: jax.Array,
-                 mesh: Mesh) -> jax.Array:
+                 mesh: Mesh, *, quant: str = "off") -> jax.Array:
     """Ring-overlapped row-split dense layer.
 
     ``h``: ``(B, T, K, *rest)`` with the first contraction dim ``K``
@@ -397,7 +661,13 @@ def tp_row_dense(h: jax.Array, kernel: jax.Array, bias: jax.Array,
     ``K``; ``bias``: ``(E,)`` replicated. Returns ``(B, T, E)``
     seq-sharded over ``model`` — the partial products are reduced around
     the ring straight into the layout the next column matmul consumes.
+
+    ``quant`` (``--quant_compute``): ``int8``/``fp8`` quantizes the
+    operands once, runs the partial dots narrow, and rotates the
+    accumulator as (q, scale) with a per-hop requant — the fused
+    dequant→dot→requant form of the reduce-scatter (``ops/quant.py``).
     """
+    _check_quant(quant)
     n = mesh.shape[MODEL_AXIS]
     ba = _batch_axis(mesh)
     _check_divisible("sequence length", h.shape[1], n)
@@ -410,19 +680,25 @@ def tp_row_dense(h: jax.Array, kernel: jax.Array, bias: jax.Array,
     h_spec = P(ba, None, MODEL_AXIS, *([None] * (h.ndim - 3)))
     k_spec = P(MODEL_AXIS, *([None] * (kernel.ndim - 1)))
     y_spec = P(ba, MODEL_AXIS, None)
-    return shard_map(_row_local, mesh=mesh,
+    fn = (_row_local if quant == "off"
+          else lambda h_, w_, b_: _row_local_q(h_, w_, b_, quant))
+    return shard_map(fn, mesh=mesh,
                      in_specs=(h_spec, k_spec, P()),
                      out_specs=y_spec, check_vma=False)(h, kernel, bias)
 
 
 def tp_row_dense_local(h_l: jax.Array, kernel: jax.Array,
-                       bias: jax.Array) -> jax.Array:
+                       bias: jax.Array, *,
+                       quant: str = "off") -> jax.Array:
     """Local (per-shard) form of :func:`tp_row_dense` for callers ALREADY
     inside a ``shard_map`` region that includes the ``model`` axis (the
     ddp×tp composed schedule): ``h_l`` is the local contraction shard
     ``(B_l, T, K_l, *rest)``, ``kernel`` the local row shard, ``bias``
     replicated (added once per reduced chunk, as in the region form)."""
-    return _row_local(h_l, kernel, bias)
+    _check_quant(quant)
+    if quant == "off":
+        return _row_local(h_l, kernel, bias)
+    return _row_local_q(h_l, kernel, bias, quant)
 
 
 # -- wire accounting -------------------------------------------------------
@@ -437,7 +713,8 @@ STACK_RINGS_BWD = 6
 
 def tp_wire_bytes_per_step(*, batch: int, seq: int, embed: int,
                            num_layers: int, n: int, vocab: int | None = None,
-                           itemsize: int = 4) -> dict[str, int]:
+                           itemsize: float = 4,
+                           quant: str = "off") -> dict[str, int]:
     """Estimated model-axis TP bytes on the wire per optimizer step.
 
     One ring op moves ``(n-1)/n`` of its full activation per model group:
@@ -456,8 +733,19 @@ def tp_wire_bytes_per_step(*, batch: int, seq: int, embed: int,
     Weight-grad psums over ``data`` are DDP bytes, not TP bytes, and are
     deliberately not counted here (``describe()`` reports them via the r9
     ``grad_wire_mb`` fields when compression is on).
+
+    ``quant`` (``--quant_compute``): under ``int8``/``fp8`` every stack
+    ring payload is the 1-byte narrow tensor plus its per-row f32 scales
+    (one scale per ``embed`` elements — the 4/E overhead), fwd AND bwd
+    (the accumulator streams requant before each hop). The LM head ring
+    is not quantized in v1 and keeps its full-precision bundle.
     """
-    per_ring = (n - 1) * batch * seq * embed * itemsize
+    stack_itemsize = itemsize
+    if quant != "off":
+        from ..ops.quant import quant_itemsize, quant_scale_overhead
+
+        stack_itemsize = quant_itemsize(quant) + quant_scale_overhead(embed)
+    per_ring = int((n - 1) * batch * seq * embed * stack_itemsize)
     stack = num_layers * (STACK_RINGS_FWD + STACK_RINGS_BWD) * per_ring
     head = 0
     if vocab is not None:

@@ -150,12 +150,63 @@ def residual_shape(stacked_shape: tuple[int, ...], data_size: int,
                                                      chunk))
 
 
-def init_residual(stacked: Any, data_size: int, chunk: int = CHUNK) -> Any:
-    """Zero error-feedback residual tree mirroring a stacked param tree."""
+def local_shard_elems(stacked_shape: tuple[int, ...], spec,
+                      model_size: int) -> int:
+    """Per-(model-)shard element count of one stacked ``(L, *s)`` leaf
+    under a Megatron placement spec (the ddp×tp residual sizing, r17):
+    dims whose spec entry names the model axis hold ``1/model_size`` of
+    the leaf locally; model-replicated leaves (LayerNorms, row biases)
+    keep their full width on every shard."""
+    from ..runtime.context import MODEL_AXIS
+
+    elems = 1
+    entries = tuple(spec or ())
+    entries = entries + (None,) * (len(stacked_shape) - len(entries))
+    for dim, entry in zip(stacked_shape[1:], entries[1:]):
+        names = (() if entry is None
+                 else ((entry,) if isinstance(entry, str) else tuple(entry)))
+        if MODEL_AXIS in names:
+            if dim % model_size:
+                raise ValueError(
+                    f"model-sharded residual dim {dim} not divisible by "
+                    f"the model-axis size {model_size}")
+            dim //= model_size
+        elems *= int(dim)
+    return elems
+
+
+def residual_shape_tp(stacked_shape: tuple[int, ...], data_size: int,
+                      model_size: int, spec,
+                      chunk: int = CHUNK) -> tuple[int, int, int, int]:
+    """ddp×tp residual leaf shape: ``(L, data_size, model_size,
+    padded_local)`` — each (data, model) coordinate keeps the
+    compensation state for exactly the grads it quantizes (its local
+    model shard of the leaf), sharded ``P(None, data, model)``."""
+    local = local_shard_elems(stacked_shape, spec, model_size)
+    return (stacked_shape[0], data_size, model_size,
+            padded_size(local, data_size, chunk))
+
+
+def init_residual(stacked: Any, data_size: int, chunk: int = CHUNK, *,
+                  tp_specs: Any | None = None,
+                  model_size: int = 1) -> Any:
+    """Zero error-feedback residual tree mirroring a stacked param tree.
+
+    ``tp_specs``/``model_size`` (the ddp×tp composition, r17): size each
+    leaf for the model-SHARDED local grads the composed drain reduces
+    (``residual_shape_tp``) instead of the replicated full width — the
+    r11 named refusal, lifted."""
+    if tp_specs is None:
+        return jax.tree.map(
+            lambda x: jnp.zeros(residual_shape(x.shape, data_size, chunk),
+                                jnp.float32),
+            stacked,
+        )
     return jax.tree.map(
-        lambda x: jnp.zeros(residual_shape(x.shape, data_size, chunk),
-                            jnp.float32),
-        stacked,
+        lambda x, spec: jnp.zeros(
+            residual_shape_tp(x.shape, data_size, model_size, spec, chunk),
+            jnp.float32),
+        stacked, tp_specs,
     )
 
 
@@ -222,19 +273,27 @@ def _leaf_allreduce(g: jax.Array, e_loc: jax.Array | None,
                                                  jax.Array | None]:
     """Per-leaf compressed cross-replica sum (inside the region).
 
-    ``g`` is the local partial grad (full leaf shape); ``e_loc`` the
-    local residual ``(1, padded)`` or None. Pads, compensates, reduces,
-    unpads."""
+    ``g`` is the local partial grad (full leaf shape — or the local
+    model shard under ddp×tp); ``e_loc`` the local residual
+    ``(1, padded)`` (``(1, 1, padded)`` under ddp×tp) or None. Pads,
+    compensates, reduces, unpads. The updated residual keeps ``e_loc``'s
+    own shape, so both layouts round-trip through the cotangent slot."""
     flat = g.reshape(-1).astype(jnp.float32)
     pad = padded_size(flat.size, n, chunk)
     if pad != flat.size:
         flat = jnp.pad(flat, (0, pad - flat.size))
     if e_loc is not None:
+        if e_loc.size != pad:
+            raise ValueError(
+                f"error-feedback residual leaf has {e_loc.size} elements "
+                f"but the padded local grad needs {pad} — the residual "
+                "was sized for a different layout/topology (init_residual "
+                "sizes per-shard under ddp×tp)")
         flat = flat + e_loc.reshape(-1)
     total, err = _reduce_flat(flat, key, mode, axis_name, n, chunk,
                               want_error=e_loc is not None)
     out = total[: g.size].reshape(g.shape).astype(g.dtype)
-    return out, None if err is None else err.reshape(1, pad)
+    return out, None if err is None else err.reshape(e_loc.shape)
 
 
 def _reduce_tree(gw: Any, res: Any | None, key: jax.Array | None, mode: str,

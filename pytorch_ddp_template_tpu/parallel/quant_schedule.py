@@ -1,0 +1,124 @@
+"""Low-precision compute glue (``--quant_compute``): validation,
+wire/FLOPs accounting and the ``describe()`` block for the quantized
+paths — the r17 sibling of the r9 ``grad_wire_mb`` and r10
+``tp_wire_mb`` conventions.
+
+The compute itself lives in ``ops/quant.py`` (scaled narrow dots, the
+Pallas fused kernel) and ``parallel/collective_matmul.py`` (the
+quantized ring kernels); ``models/transformer.py`` routes the block
+matmuls. This module is where the run's *evidence* comes from:
+
+- :func:`quant_paths` — which execution paths actually run narrow for a
+  given config (block-dense vs ring kernels), so the startup log names
+  what is quantized rather than implying everything is;
+- :func:`describe_quant`'s wire block — the model-axis ring wire under
+  quantization (``collective_matmul.tp_wire_bytes_per_step(quant=)``:
+  narrow payload + per-row scale overhead) next to the wide figure
+  actually run (fp32, or bf16 under ``--bf16``) so the ratio is
+  visible, plus the vs-fp32 ratio the acceptance bar reads (<= 0.5x);
+- :func:`quant_flops_fraction` — the share of the step's matmul FLOPs
+  running narrow (the block's four projections; attention itself and
+  the LM head stay wide in v1), which the per-dtype peak tables in
+  ``obs/attribution.py`` turn into an MFU headroom figure;
+- :func:`describe_quant` — the startup-log block ``describe()`` embeds.
+
+Import discipline: everything here is cheap host math over config
+values; no tracing, safe at startup.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..runtime.context import DATA_AXIS, MODEL_AXIS
+
+def quant_paths(config: Any) -> list[str]:
+    """Which execution paths run narrow under this config: the block
+    dense matmuls always (that is what the flag means), the ring
+    collective matmuls when composed with ``--tp_overlap``. The LM head
+    and attention stay wide in v1 (documented in README)."""
+    if getattr(config, "quant_compute", "off") == "off":
+        return []
+    paths = ["block_dense" if not getattr(config, "tp_overlap", False)
+             else "ring_collective_matmul"]
+    return paths
+
+
+def quant_flops_fraction(*, seq: int, embed: int, mlp_dim: int,
+                         num_layers: int,
+                         vocab: int | None = None) -> float:
+    """Fraction of one token's matmul FLOPs that run narrow: the four
+    block projections (qkv = 3·E², out = E², fc1/fc2 = 2·E·mlp — per
+    layer) over those plus attention's 2·T·E score/value dots per layer
+    and the (optional) vocab head AMORTISED over the stack — the head
+    runs once per model, not once per layer, so it divides by
+    ``num_layers``. The honest numerator for the per-dtype MFU headroom
+    (``obs/attribution.py``), since attention and the head stay wide."""
+    e = float(embed)
+    narrow = 4.0 * e * e + 2.0 * e * float(mlp_dim)
+    wide = 2.0 * float(seq) * e  # attention score + value dots per token
+    if vocab:
+        wide += e * float(vocab) / max(int(num_layers), 1)
+    total = narrow + wide
+    return narrow / total if total else 0.0
+
+
+def describe_quant(config: Any, model: Any, mesh) -> dict[str, Any]:
+    """The ``describe()`` quant block (r9/r10 wire-accounting
+    convention): mode, narrow paths, master-weight semantics, the
+    narrow-vs-wide FLOPs split, and — under ``--tp_overlap`` — the ring
+    wire bytes next to the wide figure the run would otherwise send
+    (keyed by its actual dtype: bf16 under ``--bf16``, else fp32) with
+    the ratio the acceptance bar reads."""
+    mode = getattr(config, "quant_compute", "off")
+    if mode == "off":
+        return {}
+    out: dict[str, Any] = {
+        "mode": mode,
+        "paths": quant_paths(config),
+        # the load-bearing semantic: the optimizer only ever sees fp32
+        "master_weights": "fp32",
+        "narrow_dtypes": ("s8" if mode == "int8"
+                          else "e4m3(values)/e5m2(cotangents)"),
+    }
+    dims = {k: getattr(model, k, None)
+            for k in ("max_len", "num_heads", "head_dim", "num_layers",
+                      "mlp_dim")}
+    if all(v is not None for v in dims.values()):
+        embed = dims["num_heads"] * dims["head_dim"]
+        vocab = (getattr(model, "vocab_size", None)
+                 if getattr(model, "fused_head", False) else None)
+        out["narrow_flops_frac"] = round(quant_flops_fraction(
+            seq=dims["max_len"], embed=embed, mlp_dim=dims["mlp_dim"],
+            num_layers=dims["num_layers"], vocab=vocab), 4)
+        sizes = dict(mesh.shape)
+        if getattr(config, "tp_overlap", False) and \
+                sizes.get(MODEL_AXIS, 1) > 1:
+            kw = dict(
+                batch=(config.per_device_train_batch_size
+                       * sizes.get(DATA_AXIS, 1)),
+                seq=dims["max_len"], embed=embed,
+                num_layers=dims["num_layers"], n=sizes[MODEL_AXIS],
+                vocab=vocab,
+                itemsize=2 if getattr(config, "bf16", False) else 4,
+            )
+            from .collective_matmul import tp_wire_bytes_per_step
+
+            wide = tp_wire_bytes_per_step(**kw)
+            narrow = tp_wire_bytes_per_step(quant=mode, **kw)
+            wide_dtype = "bf16" if getattr(config, "bf16", False) else "fp32"
+            out["tp_wire_mb_stack_quant"] = round(narrow["stack"] / 1e6, 3)
+            out[f"tp_wire_mb_stack_{wide_dtype}"] = round(
+                wide["stack"] / 1e6, 3)
+            out["tp_wire_wide_dtype"] = wide_dtype
+            out["tp_wire_stack_ratio"] = round(
+                narrow["stack"] / max(wide["stack"], 1), 4)
+            if wide_dtype != "fp32":
+                # the acceptance bar (<= 0.5x) is defined vs fp32 — emit
+                # that figure too so a bf16 run's ~0.52x vs-bf16 ratio
+                # cannot be misread as failing the bar
+                wide_fp32 = tp_wire_bytes_per_step(
+                    **{**kw, "itemsize": 4})
+                out["tp_wire_stack_ratio_vs_fp32"] = round(
+                    narrow["stack"] / max(wide_fp32["stack"], 1), 4)
+    return out

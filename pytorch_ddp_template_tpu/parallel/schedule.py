@@ -481,13 +481,6 @@ class DdpSchedule:
             raise ValueError("error-feedback residual with grad_comm=fp32 "
                              "is a no-op by construction; drop one of the "
                              "two")
-        if residual is not None and tp:
-            raise ValueError(
-                "--grad_error_feedback does not compose with --tp_overlap "
-                "yet: the residual leaves are sized for replicated full-"
-                "width grads, but the TP drain reduces model-sharded "
-                "slices; drop one of the two"
-            )
         self.mesh = mesh
         self.n = mesh.shape.get(DATA_AXIS, 1)
         self.grad_comm = grad_comm
@@ -505,7 +498,12 @@ class DdpSchedule:
             self.x_spec = P(DATA_AXIS)
         res_slice = (None if residual is None
                      else _slice_layer(residual, jnp.asarray(0)))
-        self.res_specs = jax.tree.map(lambda _: P(DATA_AXIS), res_slice)
+        # residual layout: (data, padded) replicated-param leaves, or
+        # (data, model, padded_local) under the composed ddp×tp drain
+        # (compress.residual_shape_tp — each (data, model) coordinate
+        # compensates exactly the local shard it quantizes)
+        res_spec = P(DATA_AXIS, MODEL_AXIS) if tp else P(DATA_AXIS)
+        self.res_specs = jax.tree.map(lambda _: res_spec, res_slice)
         self.has_key = comm_rng is not None
 
     def _region(self, fn, in_specs, out_specs):

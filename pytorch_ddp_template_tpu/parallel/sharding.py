@@ -347,6 +347,21 @@ def describe(mesh: Mesh, config: Any = None,
                     (comp + rest_bytes) / 1e6, 3)
                 out["grad_wire_mb_fp32"] = round(
                     (base + rest_bytes) / 1e6, 3)
+        if getattr(config, "quant_compute", "off") != "off":
+            # r17 low-precision compute block (the r9 grad_wire / r10
+            # tp_wire accounting convention): mode, narrow paths,
+            # master-weight semantics and — under tp — the quantized
+            # ring wire next to the fp32 figure. Best-effort like every
+            # other describe() figure.
+            try:
+                from .quant_schedule import describe_quant
+
+                quant_block = describe_quant(config, model, mesh)
+                if quant_block:
+                    out["quant"] = quant_block
+            except Exception:  # noqa: BLE001 - logging only
+                out["quant"] = {
+                    "mode": getattr(config, "quant_compute", "off")}
         # unified overlap summary (r11): one coherent block for a composed
         # run instead of three disjoint per-axis fragments. The legacy
         # per-axis keys above (fsdp_mode / ddp_mode / tp_mode /

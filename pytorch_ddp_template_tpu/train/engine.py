@@ -504,10 +504,19 @@ class Trainer:
         if residual is not None:
             # per-replica residual: (L, data_size, padded) leaves split
             # over ``data`` on dim 1 — each replica holds exactly its own
-            # compensation state, placed directly (never replicated)
-            res_sh = NamedSharding(self.ctx.mesh, P(None, DATA_AXIS))
-            state = state.replace(comm_residual=jax.tree.map(
-                lambda x: jax.device_put(x, res_sh), residual))
+            # compensation state, placed directly (never replicated).
+            # Under ddp×tp (r17) the leaves are (L, data, model,
+            # padded_local) and dim 2 additionally splits over ``model``
+            from ..runtime.context import MODEL_AXIS
+
+            def _place(x):
+                spec = (P(None, DATA_AXIS, MODEL_AXIS) if x.ndim == 4
+                        else P(None, DATA_AXIS))
+                return jax.device_put(
+                    x, NamedSharding(self.ctx.mesh, spec))
+
+            state = state.replace(
+                comm_residual=jax.tree.map(_place, residual))
         # scan-over-layers stacks every block weight on a leading
         # (num_layers, ...) dim — prefer splitting THERE so the whole
         # stack shards uniformly at layer granularity (one dividable axis
@@ -1559,6 +1568,11 @@ class Trainer:
             device_kind=devices.flat[0].device_kind,
             n_devices=int(devices.size),
             peak_tflops_override=self.config.peak_tflops,
+            # r17: --quant_compute selects the per-dtype peak row so the
+            # startup log + perf records carry the narrow-peak headroom
+            compute_dtype=(self.config.quant_compute
+                           if self.config.quant_compute != "off"
+                           else "bf16"),
         )
         log.info("perf attribution cost model", self.perf.describe())
 

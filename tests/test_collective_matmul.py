@@ -312,11 +312,13 @@ class TestRefusals:
         with pytest.raises(ValueError, match="--fsdp_overlap"):
             TrainingConfig(model="gpt-tiny", scan_layers=True,
                            tp_overlap=True, fsdp=True)
-        # error feedback's residual sizing assumes replicated grads
-        with pytest.raises(ValueError, match="--grad_error_feedback"):
-            TrainingConfig(model="gpt-tiny", scan_layers=True,
-                           tp_overlap=True, ddp_overlap=True,
-                           grad_comm="int8", grad_error_feedback=True)
+        # r17: EF×tp composes — the residual leaves are sized for the
+        # model-sharded layout (compress.residual_shape_tp); the config
+        # constructs and the composed telescoping test in
+        # tests/test_compress.py pins the numerics
+        TrainingConfig(model="gpt-tiny", scan_layers=True,
+                       tp_overlap=True, ddp_overlap=True,
+                       grad_comm="int8", grad_error_feedback=True)
 
     def test_mesh_level(self, devices):
         with pytest.raises(ValueError, match="mesh"):

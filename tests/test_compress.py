@@ -577,3 +577,207 @@ class TestCheckpointResidualCompat:
         assert max(float(jnp.abs(l).max())
                    for l in jax.tree.leaves(final.comm_residual)) > 0
         t2.ckpt.close()
+
+
+# -- quantizer edge cases (r17 satellite: direct units for the paths
+# previously only exercised through compressed_allreduce) -------------------
+
+class TestQuantizerEdgeCases:
+    def test_int8_single_element_chunks(self):
+        """chunk=1: every value is its own bucket — scale == |x| and the
+        roundtrip is exact up to one stochastic quantum (|x|/127)."""
+        x = jnp.asarray(np.random.default_rng(11).standard_normal(
+            (1, 8)).astype(np.float32) * 5.0)
+        q, scale = quantize_int8(x, jax.random.PRNGKey(0), chunk=1)
+        assert q.shape == (1, 8, 1) and scale.shape == (1, 8, 1)
+        back = dequantize_int8(q, scale)
+        err = np.abs(np.asarray(back) - np.asarray(x))
+        assert np.all(err <= np.abs(np.asarray(x)) / 127.0 + 1e-7)
+
+    def test_int8_mixed_zero_channels(self):
+        """All-zero buckets next to live ones: the zero buckets must
+        dequantize to exact zeros (scale pinned 1.0, not 0/0) while the
+        live buckets stay bounded."""
+        x = jnp.concatenate([jnp.zeros((1, CHUNK)),
+                             jnp.ones((1, CHUNK)) * 3.0], axis=-1)
+        q, scale = quantize_int8(x, jax.random.PRNGKey(1))
+        back = np.asarray(dequantize_int8(q, scale))
+        assert np.abs(back[0, :CHUNK]).max() == 0.0
+        assert np.abs(back[0, CHUNK:] - 3.0).max() <= 3.0 / 127.0 + 1e-7
+
+    def test_chunk_non_divisible_tail_pads_and_roundtrips(self):
+        """A 300-element leaf does not divide CHUNK: padded_size pads to
+        whole buckets per replica, the real entries survive the
+        compressed exchange within bound, and the pad region returns
+        exact zeros (all-zero buckets)."""
+        mesh = make_mesh("data:-1")
+        n = mesh.shape["data"]
+        host = {"w": _partials(n, (300,), 42)}
+        assert 300 % CHUNK != 0 and padded_size(300, n) % (n * CHUNK) == 0
+        sharded = jax.tree.map(
+            lambda x: jax.device_put(x, NamedSharding(mesh, P("data"))),
+            host)
+        out, _ = compressed_allreduce(sharded, mesh, "int8",
+                                      rng=jax.random.PRNGKey(2))
+        want = np.asarray(host["w"]).sum(axis=0)
+        got = np.asarray(out["w"])[0]
+        scale = np.abs(np.asarray(host["w"])).max() / 127.0
+        assert got.shape == (300,)
+        assert np.max(np.abs(got - want)) < (n + 2) * scale
+
+    def test_stochastic_round_bf16_zero_and_sign(self):
+        x = jnp.asarray([0.0, -0.0, 1.5, -1.5], jnp.float32)
+        out = np.asarray(stochastic_round_bf16(
+            x, jax.random.PRNGKey(3)).astype(jnp.float32))
+        assert out[0] == 0.0 and out[1] == 0.0
+        assert out[2] > 0 and out[3] < 0
+
+
+# -- EF under ddp×tp (r17 satellite: the r11 named refusal, lifted) --------
+
+class TestErrorFeedbackUnderTp:
+    def test_residual_sized_for_model_shards(self, devices):
+        """init_residual with tp specs: model-sharded kernels get
+        (L, data, model, padded_local) with the LOCAL element count;
+        model-replicated leaves keep full width per shard."""
+        from pytorch_ddp_template_tpu.parallel.compress import (
+            local_shard_elems, residual_shape_tp,
+        )
+
+        spec_k = P(None, None, "model")   # stacked column kernel
+        spec_b = P(None, None)            # stacked replicated bias
+        assert local_shard_elems((2, 32, 64), spec_k, 2) == 32 * 32
+        assert local_shard_elems((2, 64), spec_b, 2) == 64
+        shape = residual_shape_tp((2, 32, 64), 4, 2, spec_k)
+        assert shape == (2, 4, 2, padded_size(32 * 32, 4))
+        with pytest.raises(ValueError, match="not divisible"):
+            local_shard_elems((2, 32, 63), spec_k, 2)
+
+    def test_composed_telescoping_identity(self, devices):
+        """The acceptance pin at the composed geometry: on data×model,
+        each (data, model) coordinate's compressed per-shard grads plus
+        its residual cotangent reconstruct the true fp32 grads — the
+        telescoping identity surviving the model-sharded drain."""
+        mesh = make_mesh("data:4,model:2")
+        cfg = TrainingConfig(
+            model="gpt-tiny", mesh="data:4,model:2", scan_layers=True,
+            ddp_overlap=True, tp_overlap=True, grad_comm="int8",
+            grad_error_feedback=True, warmup_steps=0)
+        task, _ = build("gpt-tiny", cfg, mesh=mesh)
+        batch = {"input_ids": jax.device_put(
+            jnp.asarray(np.random.default_rng(0).integers(
+                0, 1024, (8, 128)), jnp.int32),
+            NamedSharding(mesh, P("data")))}
+        params, extra = task.init(jax.random.PRNGKey(0), batch)
+        residual = extra.pop("comm_residual")
+        # every leaf carries the 4D model-sharded layout
+        for leaf in jax.tree.leaves(residual):
+            assert leaf.ndim == 4 and leaf.shape[1:3] == (4, 2)
+        res_sh = NamedSharding(mesh, P(None, "data", "model"))
+        residual = jax.tree.map(
+            lambda x: jax.device_put(x, res_sh), residual)
+
+        def loss_fn(p, ev):
+            loss, _, _ = task.loss(p, ev, batch, jax.random.PRNGKey(1),
+                                   train=True)
+            return loss
+
+        ev_in = {**extra, "comm_residual": residual}
+        _, (grads, ev_ct) = jax.jit(jax.value_and_grad(
+            loss_fn, argnums=(0, 1)))(params, ev_in)
+        res_ct = ev_ct["comm_residual"]
+        # the residual updated (compression really ran, error kept back)
+        assert max(float(jnp.abs(l).max())
+                   for l in jax.tree.leaves(res_ct)) > 0
+        # telescoping: int8 grads + residual == exact-fp32-comms grads.
+        # Build the fp32-wire twin (EF off) from the SAME init.
+        cfg32 = TrainingConfig(
+            model="gpt-tiny", mesh="data:4,model:2", scan_layers=True,
+            ddp_overlap=True, tp_overlap=True, warmup_steps=0)
+        task32, _ = build("gpt-tiny", cfg32, mesh=mesh)
+
+        def loss32(p):
+            loss, _, _ = task32.loss(p, extra, batch,
+                                     jax.random.PRNGKey(1), train=True)
+            return loss
+
+        _, g32 = jax.jit(jax.value_and_grad(loss32))(params)
+        stack8 = nn.meta.unbox(grads)["decoder"]["layers"]
+        stack32 = nn.meta.unbox(g32)["decoder"]["layers"]
+        flat8, _ = jax.tree_util.tree_flatten_with_path(stack8)
+        flat_res = jax.tree.leaves(res_ct)
+        flat32 = jax.tree.leaves(stack32)
+        from pytorch_ddp_template_tpu.parallel.schedule import (
+            stacked_tp_specs,
+        )
+        specs = jax.tree.leaves(
+            stacked_tp_specs(stack32, mesh),
+            is_leaf=lambda s: isinstance(s, P))
+        assert len(flat8) == len(flat_res) == len(flat32) == len(specs)
+        checked_rep = checked_shard = 0
+        model_size = 2
+        for (path, g8), res, gt, spec in zip(flat8, flat_res, flat32,
+                                             specs):
+            entries = tuple(spec)[1:]
+            model_dims = [i for i, e in enumerate(entries)
+                          if e is not None and "model" in (
+                              (e,) if isinstance(e, str) else tuple(e))]
+            L = gt.shape[0]
+            g8_np, gt_np, res_np = (np.asarray(g8), np.asarray(gt),
+                                    np.asarray(res))
+            if not model_dims:
+                # replicated leaves: every (d, m) coordinate saw the
+                # same full-width grads — any model column's residual
+                # summed over data reconstructs the truth
+                per_layer = int(np.prod(gt.shape[1:]))
+                recon = (g8_np.reshape(L, -1)
+                         + res_np[:, :, 0, :].sum(axis=1)[:, :per_layer])
+                np.testing.assert_allclose(
+                    recon, gt_np.reshape(L, -1), atol=5e-4)
+                checked_rep += 1
+                continue
+            # model-SHARDED kernels — the leaves residual_shape_tp
+            # exists for: coordinate m's residual compensates exactly
+            # its local slice, so the identity must hold PER COLUMN
+            (md,) = model_dims  # block kernels shard on one dim
+            axis = md + 1  # + the leading layer dim
+            loc = gt.shape[axis] // model_size
+            per_local = int(np.prod(gt.shape[1:])) // model_size
+            for m in range(model_size):
+                sl = [slice(None)] * gt_np.ndim
+                sl[axis] = slice(m * loc, (m + 1) * loc)
+                recon = (g8_np[tuple(sl)].reshape(L, -1)
+                         + res_np[:, :, m, :].sum(axis=1)[:, :per_local])
+                np.testing.assert_allclose(
+                    recon, gt_np[tuple(sl)].reshape(L, -1), atol=5e-4)
+            checked_shard += 1
+        assert checked_rep >= 4   # LNs + row biases
+        assert checked_shard >= 6  # qkv/out/fc1/fc2 kernels + col biases
+
+    def test_trainer_runs_ef_under_tp(self, devices, tmp_path):
+        """Engine-level composition: the Trainer inits the 4D residual,
+        places it P(None, data, model), trains, and the residual leaves
+        update — the CLI surface of the lifted refusal."""
+        from pytorch_ddp_template_tpu.runtime.context import RuntimeContext
+        from pytorch_ddp_template_tpu.train.engine import Trainer
+
+        cfg = TrainingConfig(
+            model="gpt-tiny", mesh="data:4,model:2", scan_layers=True,
+            ddp_overlap=True, tp_overlap=True, grad_comm="int8",
+            grad_error_feedback=True, warmup_steps=0, max_steps=2,
+            per_device_train_batch_size=2, dataset_size=64,
+            logging_steps=1, save_steps=0, eval_steps=0, resume=False,
+            output_dir=str(tmp_path))
+        mesh = make_mesh(cfg.mesh)
+        key = jax.random.PRNGKey(0)
+        ctx = RuntimeContext(mesh=mesh, seed_key=key,
+                             host_key=jax.random.fold_in(key, 0),
+                             config=cfg)
+        task, ds = build(cfg.model, cfg, mesh=mesh)
+        t = Trainer(cfg, ctx, task, ds)
+        state = t.train()
+        assert int(state.step) == 2
+        assert state.comm_residual is not None
+        assert max(float(jnp.abs(l).max())
+                   for l in jax.tree.leaves(state.comm_residual)) > 0
+        t.ckpt.close()

@@ -1,0 +1,513 @@
+"""Low-precision compute path (``--quant_compute``, ops/quant.py + the
+quantized ring kernels in parallel/collective_matmul.py): the quantizers
+must be bounded per channel (all-zero channels exactly zero), the scaled
+narrow dots must be algebraically exact given the quantized operands, the
+Pallas fused kernel must match the XLA lowering, quant_dense must agree
+with the plain dense within the documented per-dtype bounds in value AND
+grads, the block/ring integrations must keep the param tree
+bit-interchangeable with the default path (off == default bitwise), the
+refusal matrix must fail with intent, and the evidence stack (describe()
+block, per-dtype peak rows, the --hlo_report quant tripwire) must report
+what actually compiled."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from pytorch_ddp_template_tpu.config import TrainingConfig
+from pytorch_ddp_template_tpu.models import build
+from pytorch_ddp_template_tpu.ops.quant import (
+    FP8_BWD_DTYPE,
+    FP8_FWD_DTYPE,
+    QUANT_COMPUTE_MODES,
+    dequantize,
+    quant_dense,
+    quant_dot,
+    quant_matmul_pallas,
+    quantize_channel,
+    roundtrip_rel_error_bound,
+)
+from pytorch_ddp_template_tpu.runtime import make_mesh
+
+TOL_REL = {"int8": 0.05, "fp8": 0.25}  # loose per-dtype parity bands
+
+
+def _rand(shape, seed=0, scale=1.0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.standard_normal(shape).astype(np.float32) * scale)
+
+
+def _rel(a, b):
+    denom = float(jnp.max(jnp.abs(b))) + 1e-9
+    return float(jnp.max(jnp.abs(a - b))) / denom
+
+
+# -- quantizer units -------------------------------------------------------
+
+class TestQuantizeChannel:
+    @pytest.mark.parametrize("mode", ["int8", "fp8"])
+    def test_roundtrip_bounded_per_channel(self, mode):
+        x = _rand((8, 64), 1, 3.0)
+        q, s = quantize_channel(x, mode, axes=-1)
+        err = jnp.max(jnp.abs(dequantize(q, s) - x), axis=-1)
+        amax = jnp.max(jnp.abs(x), axis=-1)
+        bound = roundtrip_rel_error_bound(mode)
+        assert float(jnp.max(err / amax)) <= bound + 1e-7
+
+    def test_all_zero_channels_stay_exact_zero(self):
+        # mixed rows: zero channels must dequantize to exact zeros even
+        # next to live ones (scale pinned to 1.0, never 0/0)
+        x = jnp.concatenate([jnp.zeros((2, 32)), _rand((2, 32), 2)], axis=0)
+        for mode in ("int8", "fp8"):
+            q, s = quantize_channel(x, mode, axes=-1)
+            back = dequantize(q, s)
+            assert float(jnp.max(jnp.abs(back[:2]))) == 0.0
+            assert float(jnp.max(jnp.abs(back[2:]))) > 0.0
+
+    def test_single_element_channels(self):
+        # one element per channel: absmax == the value, so int8 encodes
+        # +-127 exactly and the roundtrip is (near-)exact
+        x = _rand((16, 1), 3)
+        q, s = quantize_channel(x, "int8", axes=-1)
+        np.testing.assert_allclose(np.asarray(dequantize(q, s)),
+                                   np.asarray(x), rtol=1e-6)
+
+    def test_stochastic_rounding_unbiased(self):
+        x = _rand((64,), 4)
+        keys = jax.random.split(jax.random.PRNGKey(0), 256)
+        draws = jax.vmap(lambda k: dequantize(
+            *quantize_channel(x, "int8", axes=-1, key=k)))(keys)
+        quantum = float(jnp.max(jnp.abs(x))) / 127.0
+        bias = np.max(np.abs(np.asarray(jnp.mean(draws, 0)) - np.asarray(x)))
+        assert bias < 4.0 * 0.5 * quantum / np.sqrt(256) + 1e-7
+
+    def test_fp8_dtypes_and_grad_mode(self):
+        x = _rand((4, 8), 5)
+        q, _ = quantize_channel(x, "fp8", axes=-1)
+        assert q.dtype == FP8_FWD_DTYPE
+        qg, _ = quantize_channel(x, "fp8", axes=-1, grad=True)
+        assert qg.dtype == FP8_BWD_DTYPE
+
+    def test_unknown_mode_refused(self):
+        with pytest.raises(ValueError, match="unknown mode"):
+            quantize_channel(jnp.zeros((4, 4)), "int4")
+        with pytest.raises(ValueError, match="unknown mode"):
+            quantize_channel(jnp.zeros((4, 4)), "off")
+
+
+def test_quant_dot_exact_given_quantized_operands():
+    """The scaled dot is algebraically exact: quant_dot must equal
+    dequantize-then-matmul to float tolerance (the only error in the
+    path is the operand rounding, never the scale algebra)."""
+    a = _rand((8, 32), 6)
+    w = _rand((32, 16), 7)
+    for mode in ("int8", "fp8"):
+        aq, as_ = quantize_channel(a, mode, axes=-1)
+        wq, ws = quantize_channel(w, mode, axes=0)
+        got = quant_dot(aq, as_, wq, ws.reshape(1, -1))
+        want = dequantize(aq, as_) @ dequantize(wq, ws)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_pallas_kernel_matches_xla_lowering():
+    a = _rand((16, 64), 8)
+    w = _rand((64, 32), 9)
+    for mode in ("int8", "fp8"):
+        aq, as_ = quantize_channel(a, mode, axes=-1)
+        wq, ws = quantize_channel(w, mode, axes=0)
+        ws2 = ws.reshape(1, -1)
+        xla = quant_dot(aq, as_, wq, ws2)
+        fused = quant_matmul_pallas(aq, as_, wq, ws2, interpret=True)
+        # int8 accumulates in int32 in both lowerings: bit-equal; fp8
+        # accumulation order may differ at the last f32 ulp
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(xla),
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_quant_impl_env(monkeypatch):
+    from pytorch_ddp_template_tpu.ops import quant as Q
+
+    monkeypatch.setenv("QUANT_IMPL", "nope")
+    with pytest.raises(ValueError, match="QUANT_IMPL"):
+        Q.quant_impl()
+    monkeypatch.setenv("QUANT_IMPL", "pallas")
+    assert Q.quant_impl() == "pallas"
+    monkeypatch.delenv("QUANT_IMPL")
+    assert Q.quant_impl() == "xla"
+
+
+class TestQuantDense:
+    @pytest.mark.parametrize("mode", ["int8", "fp8"])
+    def test_value_and_grads_near_plain(self, mode):
+        x = _rand((4, 8, 32), 10)
+        k = _rand((32, 4, 8), 11)
+        b = _rand((4, 8), 12, 0.1)
+
+        def plain(x, k, b):
+            return jnp.einsum("bte,ehd->bthd", x, k) + b
+
+        def q(x, k, b):
+            return quant_dense(x, k, b, 1, mode)
+
+        y, yr = q(x, k, b), plain(x, k, b)
+        assert _rel(y, yr) < TOL_REL[mode]
+        g = jax.grad(lambda *a: jnp.sum(q(*a) ** 2), argnums=(0, 1, 2))(
+            x, k, b)
+        gr = jax.grad(lambda *a: jnp.sum(plain(*a) ** 2),
+                      argnums=(0, 1, 2))(x, k, b)
+        for a_, r_ in zip(g, gr):
+            assert _rel(a_, r_) < 2 * TOL_REL[mode]
+
+    def test_two_axis_contraction(self):
+        # the out-projection shape: (B,T,H,D) x (H,D,E)
+        x = _rand((2, 4, 2, 8), 13)
+        k = _rand((2, 8, 16), 14)
+        y = quant_dense(x, k, jnp.zeros(16), 2, "int8")
+        yr = jnp.einsum("bthd,hde->bte", x, k)
+        assert _rel(y, yr) < TOL_REL["int8"]
+
+    def test_pallas_impl_through_quant_dense(self, monkeypatch):
+        monkeypatch.setenv("QUANT_IMPL", "pallas")
+        jax.clear_caches()
+        x, k, b = _rand((8, 32), 15), _rand((32, 16), 16), jnp.zeros(16)
+        y = quant_dense(x, k, b, 1, "int8")
+        monkeypatch.setenv("QUANT_IMPL", "xla")
+        jax.clear_caches()
+        y2 = quant_dense(x, k, b, 1, "int8")
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y2),
+                                   rtol=1e-6, atol=1e-6)
+        jax.clear_caches()
+
+
+# -- ring kernels ----------------------------------------------------------
+
+class TestQuantRingKernels:
+    @pytest.mark.parametrize("mode", ["int8", "fp8"])
+    def test_column_parity_and_grads(self, devices, mode):
+        from pytorch_ddp_template_tpu.parallel.collective_matmul import (
+            tp_column_dense,
+        )
+
+        mesh = make_mesh("data:2,model:4", jax.devices())
+        x, w, b = _rand((4, 16, 32), 20), _rand((32, 64), 21), \
+            _rand((64,), 22, 0.1)
+
+        def col(quant):
+            return lambda x, w, b: jnp.sum(tp_column_dense(
+                x, [w], [b], mesh, quant=quant)[0] ** 2)
+
+        ref, gr = jax.value_and_grad(col("off"), argnums=(0, 1, 2))(x, w, b)
+        got, g = jax.value_and_grad(col(mode), argnums=(0, 1, 2))(x, w, b)
+        assert abs(float(got) - float(ref)) / abs(float(ref)) < TOL_REL[mode]
+        for a_, r_ in zip(g, gr):
+            assert _rel(a_, r_) < 2 * TOL_REL[mode]
+
+    @pytest.mark.parametrize("mode", ["int8", "fp8"])
+    def test_row_parity_and_grads(self, devices, mode):
+        from pytorch_ddp_template_tpu.parallel.collective_matmul import (
+            tp_row_dense,
+        )
+
+        mesh = make_mesh("data:2,model:4", jax.devices())
+        h, w, b = _rand((4, 16, 64), 23), _rand((64, 32), 24), \
+            _rand((32,), 25, 0.1)
+
+        def row(quant):
+            return lambda h, w, b: jnp.sum(tp_row_dense(
+                h, w, b, mesh, quant=quant) ** 2)
+
+        ref, gr = jax.value_and_grad(row("off"), argnums=(0, 1, 2))(h, w, b)
+        got, g = jax.value_and_grad(row(mode), argnums=(0, 1, 2))(h, w, b)
+        assert abs(float(got) - float(ref)) / abs(float(ref)) < TOL_REL[mode]
+        for a_, r_ in zip(g, gr):
+            assert _rel(a_, r_) < 2 * TOL_REL[mode]
+
+    def test_unknown_quant_refused(self, devices):
+        from pytorch_ddp_template_tpu.parallel.collective_matmul import (
+            tp_column_dense, tp_row_dense_local,
+        )
+
+        mesh = make_mesh("data:2,model:4", jax.devices())
+        with pytest.raises(ValueError, match="unknown quant_compute"):
+            tp_column_dense(jnp.zeros((2, 8, 8)), [jnp.zeros((8, 8))],
+                            [jnp.zeros(8)], mesh, quant="int4")
+        with pytest.raises(ValueError, match="unknown quant_compute"):
+            tp_row_dense_local(jnp.zeros((2, 8, 8)), jnp.zeros((8, 8)),
+                               jnp.zeros(8), quant="int4")
+
+
+# -- block / task integration ----------------------------------------------
+
+def _gpt_tiny_loss_and_grad(cfg_kwargs, mesh=None, batch_rows=4):
+    key = jax.random.PRNGKey(0)
+    batch = {"input_ids": jnp.asarray(
+        np.random.default_rng(0).integers(0, 1024, (batch_rows, 128)),
+        jnp.int32)}
+    cfg = TrainingConfig(model="gpt-tiny", **cfg_kwargs)
+    task, _ = build("gpt-tiny", cfg, mesh=mesh)
+    params, extra = task.init(key, batch)
+
+    def lf(p):
+        loss, _, _ = task.loss(p, extra, batch, jax.random.PRNGKey(1),
+                               train=True)
+        return loss
+
+    loss, grads = jax.jit(jax.value_and_grad(lf))(params)
+    return float(loss), grads, params
+
+
+def test_quant_off_is_bitwise_the_default_path(devices):
+    """--quant_compute off must not perturb the shipped numerics by one
+    bit — same loss, same grads, same param tree as a build that never
+    mentions the flag."""
+    l0, g0, p0 = _gpt_tiny_loss_and_grad({})
+    l1, g1, p1 = _gpt_tiny_loss_and_grad({"quant_compute": "off"})
+    assert l0 == l1
+    for a, b in zip(jax.tree.leaves(nn.meta.unbox(g0)),
+                    jax.tree.leaves(nn.meta.unbox(g1))):
+        assert bool(jnp.all(a == b))
+
+
+@pytest.mark.parametrize("mode", ["int8", "fp8"])
+def test_quant_block_param_tree_interchangeable_and_close(devices, mode):
+    """The _DenseParams twins keep checkpoints bit-interchangeable with
+    the default path, and the quantized loss/grads track the fp32 ones
+    within the per-dtype band."""
+    l0, g0, p0 = _gpt_tiny_loss_and_grad({})
+    lm, gm, pm = _gpt_tiny_loss_and_grad({"quant_compute": mode})
+    for a, b in zip(jax.tree.leaves(nn.meta.unbox(p0)),
+                    jax.tree.leaves(nn.meta.unbox(pm))):
+        assert a.shape == b.shape and bool(jnp.all(a == b))
+    assert abs(lm - l0) / abs(l0) < TOL_REL[mode]
+    rel = max(_rel(a, b) for a, b in zip(
+        jax.tree.leaves(nn.meta.unbox(gm)),
+        jax.tree.leaves(nn.meta.unbox(g0))))
+    assert rel < 10 * TOL_REL[mode]  # grads amplify through the stack
+
+
+def test_quant_composes_with_scan_and_tp(devices):
+    mesh = make_mesh("data:4,model:2", jax.devices())
+    l, g, _ = _gpt_tiny_loss_and_grad(
+        {"quant_compute": "int8", "scan_layers": True, "tp_overlap": True,
+         "mesh": "data:4,model:2"}, mesh=mesh, batch_rows=8)
+    assert np.isfinite(l)
+    l0, _, _ = _gpt_tiny_loss_and_grad(
+        {"scan_layers": True, "tp_overlap": True, "mesh": "data:4,model:2"},
+        mesh=mesh, batch_rows=8)
+    assert abs(l - l0) / abs(l0) < TOL_REL["int8"]
+
+
+# -- refusal matrix --------------------------------------------------------
+
+class TestRefusals:
+    def test_config_level(self):
+        with pytest.raises(ValueError, match="unknown --quant_compute"):
+            TrainingConfig(model="gpt-tiny", quant_compute="int4")
+        # every legal mode constructs
+        for mode in QUANT_COMPUTE_MODES:
+            TrainingConfig(model="gpt-tiny", quant_compute=mode)
+
+    def test_registry_level(self, devices):
+        cfg = TrainingConfig(model="mlp", quant_compute="int8")
+        with pytest.raises(ValueError, match="transformer families only"):
+            build("mlp", cfg)
+        cfg = TrainingConfig(model="gpt-moe-tiny", quant_compute="int8")
+        with pytest.raises(ValueError, match="MoE entries"):
+            build("gpt-moe-tiny", cfg)
+        cfg = TrainingConfig(model="gpt-pipe-tiny", quant_compute="int8",
+                             mesh="data:4,pipe:2")
+        with pytest.raises(ValueError, match="pipelined"):
+            build("gpt-pipe-tiny", cfg)
+
+    def test_encoder_level(self, devices):
+        from pytorch_ddp_template_tpu.models.transformer import (
+            TransformerEncoder,
+        )
+
+        enc = TransformerEncoder(num_layers=1, num_heads=2, head_dim=8,
+                                 mlp_dim=16, moe_experts=2,
+                                 quant_compute="int8")
+        with pytest.raises(ValueError, match="MoE blocks"):
+            enc.init(jax.random.PRNGKey(0), jnp.zeros((2, 4, 16)))
+        enc = TransformerEncoder(num_layers=1, num_heads=2, head_dim=8,
+                                 mlp_dim=16, quant_compute="int4")
+        with pytest.raises(ValueError, match="unknown quant_compute"):
+            enc.init(jax.random.PRNGKey(0), jnp.zeros((2, 4, 16)))
+
+
+# -- evidence stack --------------------------------------------------------
+
+def test_describe_quant_block(devices):
+    from pytorch_ddp_template_tpu.parallel.sharding import describe
+
+    mesh = make_mesh("data:4,model:2", jax.devices())
+    cfg = TrainingConfig(model="gpt-tiny", scan_layers=True,
+                         tp_overlap=True, quant_compute="int8",
+                         mesh="data:4,model:2")
+    task, _ = build("gpt-tiny", cfg, mesh=mesh)
+    d = describe(mesh, cfg, None, model=task.model)
+    q = d["quant"]
+    assert q["mode"] == "int8"
+    assert q["master_weights"] == "fp32"
+    assert q["paths"] == ["ring_collective_matmul"]
+    assert 0 < q["narrow_flops_frac"] < 1
+    assert q["tp_wire_stack_ratio"] <= 0.5
+    # off: no block at all
+    cfg_off = TrainingConfig(model="gpt-tiny")
+    d_off = describe(mesh, cfg_off, None)
+    assert "quant" not in d_off
+
+
+def test_quant_wire_accounting(devices):
+    from pytorch_ddp_template_tpu.parallel.collective_matmul import (
+        tp_wire_bytes_per_step,
+    )
+
+    kw = dict(batch=8, seq=128, embed=128, num_layers=4, n=4, vocab=1024)
+    wide = tp_wire_bytes_per_step(**kw)
+    for mode in ("int8", "fp8"):
+        narrow = tp_wire_bytes_per_step(quant=mode, **kw)
+        # 1 byte + 4/128 scale overhead vs 4 bytes = 0.2578x
+        assert narrow["stack"] / wide["stack"] == pytest.approx(
+            (1 + 4 / 128) / 4, rel=1e-6)
+        assert narrow["head"] == wide["head"]  # head not quantized in v1
+
+
+def test_peak_flops_per_dtype_rows():
+    from pytorch_ddp_template_tpu.obs.attribution import (
+        PerfAttribution, peak_flops_for,
+    )
+
+    assert peak_flops_for("TPU v5e", dtype="int8") == 394e12
+    assert peak_flops_for("TPU v6e", dtype="fp8") == 1836e12
+    # generations without the narrow path: absent, never invented
+    assert peak_flops_for("TPU v5e", dtype="fp8") is None
+    assert peak_flops_for("TPU v3", dtype="int8") is None
+    with pytest.raises(ValueError, match="unknown dtype"):
+        peak_flops_for("TPU v5e", dtype="int4")
+    # the override wins regardless of dtype
+    assert peak_flops_for("cpu", 1.5, dtype="int8") == 1.5e12
+
+    cm = {"flops_per_step": 1e12}
+    perf = PerfAttribution(cm, device_kind="TPU v5e", n_devices=2,
+                           compute_dtype="int8")
+    d = perf.describe()
+    assert d["quant_compute"] == "int8"
+    assert d["peak_tflops_int8"] == pytest.approx(2 * 394.0)
+    assert d["quant_peak_headroom"] == pytest.approx(2.0)
+    out = perf.interval(wall_s=1.0, steps=1)
+    assert out["perf_mfu_vs_quant_peak"] == pytest.approx(
+        1e12 / (2 * 394e12), abs=5e-5)  # the record rounds to 4 places
+    assert out["perf_mfu"] > out["perf_mfu_vs_quant_peak"]
+    # CPU: no narrow row -> no headroom keys, nothing invented
+    perf_cpu = PerfAttribution(cm, device_kind="cpu",
+                               compute_dtype="int8")
+    assert "quant_peak_headroom" not in perf_cpu.describe()
+    assert "perf_mfu_vs_quant_peak" not in perf_cpu.interval(
+        wall_s=1.0, steps=1)
+
+
+SYNTHETIC_NARROW_HLO = """
+HloModule toy
+%ring_body (p: (s8[4,8], f32[4,1], f32[8,8])) -> (s8[4,8], f32[4,1], f32[8,8]) {
+  %p = parameter(0)
+  %q = s8[4,8]{1,0} get-tuple-element(%p), index=0
+  %s = f32[4,1]{1,0} get-tuple-element(%p), index=1
+  %acc = f32[8,8]{1,0} get-tuple-element(%p), index=2
+  %qc = f32[4,8]{1,0} convert(s8[4,8]{1,0} %q)
+  %dot.1 = f32[4,8]{1,0} dot(f32[4,8]{1,0} %qc, f32[8,8]{1,0} %acc), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %pp = s8[4,8]{1,0} collective-permute(s8[4,8]{1,0} %q), source_target_pairs={{0,1},{1,0}}
+  ROOT %t = (s8[4,8], f32[4,1], f32[8,8]) tuple(%pp, %s, %acc)
+}
+ENTRY %main (a: f32[4,8]) -> f32[4,8] {
+  %a = parameter(0)
+  %w8 = s8[8,8]{1,0} constant({...})
+  %wc = f32[8,8]{1,0} convert(s8[8,8]{1,0} %w8)
+  ROOT %dot.2 = f32[4,8]{1,0} dot(f32[4,8]{1,0} %a, f32[8,8]{1,0} %wc), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+}
+"""
+
+
+def test_quant_evidence_synthetic():
+    from pytorch_ddp_template_tpu.obs.hlo_report import quant_evidence
+
+    ev = quant_evidence(SYNTHETIC_NARROW_HLO)
+    # both dots are narrow-fed (operands are converts FROM s8)
+    assert ev["narrow_dots"] == 2
+    assert ev["quant_dots_present"] is True
+    assert ev["narrow_ppermutes"] == 1
+    # the ring body converts FROM narrow only — quantization hoisted
+    assert ev["hoisted_quant_ring_bodies"] == 1
+    assert ev["requant_ring_bodies"] == 0
+    # a wide program carries nothing
+    wide = quant_evidence("ENTRY %m (a: f32[4]) -> f32[4] {\n"
+                          "  ROOT %a = parameter(0)\n}")
+    assert wide["quant_dots_present"] is False
+
+
+SYNTHETIC_REQUANT_HLO = """
+HloModule toy
+%ring_body (p: (s8[4,8], f32[8,8])) -> (s8[4,8], f32[8,8]) {
+  %p = parameter(0)
+  %q = s8[4,8]{1,0} get-tuple-element(%p), index=0
+  %acc = f32[8,8]{1,0} get-tuple-element(%p), index=1
+  %qc = f32[4,8]{1,0} convert(s8[4,8]{1,0} %q)
+  %dot.1 = f32[4,8]{1,0} dot(f32[4,8]{1,0} %qc, f32[8,8]{1,0} %acc), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %rq = s8[4,8]{1,0} convert(f32[4,8]{1,0} %dot.1)
+  %pp = s8[4,8]{1,0} collective-permute(s8[4,8]{1,0} %rq), source_target_pairs={{0,1},{1,0}}
+  ROOT %t = (s8[4,8], f32[8,8]) tuple(%pp, %acc)
+}
+ENTRY %main (a: f32[4,8]) -> f32[4,8] {
+  %a = parameter(0)
+  ROOT %id = f32[4,8]{1,0} copy(f32[4,8]{1,0} %a)
+}
+"""
+
+
+def test_quant_evidence_requant_body_not_hoisted():
+    # a ring body that re-quantizes its payload per hop (convert TO a
+    # narrow result feeding the ppermute) must count as a requant body,
+    # not a hoisted one — this is the regression the tripwire exists to
+    # catch (the hoisting witness must read the RESULT dtype of the
+    # convert, not the operand's)
+    from pytorch_ddp_template_tpu.obs.hlo_report import (
+        check_overlap_expectations, quant_evidence, schedule_report,
+    )
+
+    ev = quant_evidence(SYNTHETIC_REQUANT_HLO)
+    assert ev["narrow_ppermutes"] == 1
+    assert ev["narrow_ring_bodies"] == 1
+    assert ev["hoisted_quant_ring_bodies"] == 0
+    assert ev["requant_ring_bodies"] == 1
+    # and with zero hoisted bodies the composed tripwire fires
+    cfg = TrainingConfig(model="gpt-tiny", scan_layers=True,
+                         tp_overlap=True, quant_compute="int8",
+                         mesh="data:2,model:2")
+    report = schedule_report(SYNTHETIC_REQUANT_HLO)
+    warns = check_overlap_expectations(report, cfg,
+                                       {"data": 2, "model": 2})
+    assert any("re-quantizes inside the loop" in w for w in warns)
+
+
+def test_quant_tripwire_warns_on_wide_program():
+    from pytorch_ddp_template_tpu.obs.hlo_report import (
+        check_overlap_expectations, schedule_report,
+    )
+
+    cfg = TrainingConfig(model="gpt-tiny", scan_layers=True,
+                         tp_overlap=True, quant_compute="int8",
+                         mesh="data:2,model:2")
+    report = schedule_report("ENTRY %m (a: f32[4]) -> f32[4] {\n"
+                             "  ROOT %a = parameter(0)\n}")
+    warns = check_overlap_expectations(report, cfg,
+                                       {"data": 2, "model": 2})
+    assert any("NO narrow-dtype dots" in w for w in warns)
+    assert any("ring wire is wide" in w for w in warns)
+    # quant off: no quant warnings
+    cfg_off = TrainingConfig(model="gpt-tiny")
+    warns_off = check_overlap_expectations(report, cfg_off, {"data": 2})
+    assert not any("quant" in w.lower() for w in warns_off)

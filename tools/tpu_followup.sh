@@ -19,10 +19,10 @@
 # bench mode appends one JSON line to its round's records file.
 # Usage: bash tools/tpu_followup.sh <round>   (requires the axon tunnel)
 set -u
-ROUND=${1:?usage: tpu_followup.sh <round: 4..16>}
+ROUND=${1:?usage: tpu_followup.sh <round: 4..17>}
 case "$ROUND" in (*[!0-9]*|'') echo "round must be a number, got '$ROUND'" >&2; exit 2;; esac
-if [ "$ROUND" -lt 4 ] || [ "$ROUND" -gt 16 ]; then
-  echo "unknown round $ROUND (expected 4..16)" >&2; exit 2
+if [ "$ROUND" -lt 4 ] || [ "$ROUND" -gt 17 ]; then
+  echo "unknown round $ROUND (expected 4..17)" >&2; exit 2
 fi
 cd "$(dirname "$0")/.."
 R=bench_records
@@ -258,6 +258,37 @@ legs_r16() {
   wait "$train_pid" || RC=1
   cp /tmp/pipe_tpu_r16/hlo_report.json "$R/pipe_hlo_report_tpu_r16.json" \
     2>/dev/null && echo "pipe hlo_report (tripwire clean?) copied" >&2
+}
+
+legs_r17() {
+  # low-precision compute: the r17 real-hardware data the CPU record
+  # cannot produce — the CPU host has no narrow MXU (XLA upcasts the
+  # int8/fp8 operands, so the committed quant_cpu_r17.jsonl step ratios
+  # price the quantize overhead only; the record carries
+  # cpu_no_narrow_mxu=true). On real chips: (a) the full quant legs —
+  # on v5e+ expect the int8 step ratio to INVERT (narrow-MXU dots at
+  # 2x the bf16 peak, obs/attribution.py PEAK_FLOPS_BY_DTYPE); fp8
+  # needs v6e — on earlier generations the fp8 leg measures the e4m3
+  # storage/wire win with bf16-rate dots (record it, flag the
+  # generation); (b) quantized train legs via the BENCH_QUANT lever
+  # (ablation-keyed) incl. the quant × tp composition whose ppermutes
+  # carry the narrow ring payloads over real ICI; (c) a production run
+  # with --quant_compute int8 --hlo_report --perf_report: the quant
+  # tripwire on real Mosaic lowering (narrow dots should appear
+  # NATIVELY, not behind converts) + the per-dtype peak rows /
+  # quant_peak_headroom in the startup log and perf records.
+  run quant_legs quant_tpu_r17.jsonl 2400 BENCH_MODE=quant BENCH_STEPS=20 BENCH_WARMUP=3
+  run quant_train_off  quant_tpu_r17.jsonl 1200 BENCH_MODE=train BENCH_MODEL=gpt-small BENCH_BATCH=4 BENCH_SCAN=1
+  run quant_train_int8 quant_tpu_r17.jsonl 1200 BENCH_MODE=train BENCH_MODEL=gpt-small BENCH_BATCH=4 BENCH_SCAN=1 BENCH_QUANT=int8
+  run quant_train_fp8  quant_tpu_r17.jsonl 1200 BENCH_MODE=train BENCH_MODEL=gpt-small BENCH_BATCH=4 BENCH_SCAN=1 BENCH_QUANT=fp8
+  run quant_tp_int8    quant_tpu_r17.jsonl 1200 BENCH_MODE=train BENCH_MODEL=gpt-small BENCH_BATCH=4 BENCH_SCAN=1 BENCH_TP_OVERLAP=1 BENCH_QUANT=int8
+  timeout 1200 python ddp.py --model gpt-small --scan_layers \
+    --quant_compute int8 --hlo_report --perf_report --max_steps 30 \
+    --per_device_train_batch_size 4 --logging_steps 5 --save_steps 0 \
+    --dataset_size 2048 --no_resume --output_dir /tmp/quant_tpu_r17 \
+    2>>"$ERR" || RC=1
+  cp /tmp/quant_tpu_r17/hlo_report.json "$R/quant_hlo_report_tpu_r17.json" \
+    2>/dev/null && echo "quant hlo_report (tripwire clean?) copied" >&2
 }
 
 # -- the historical chain ---------------------------------------------------

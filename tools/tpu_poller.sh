@@ -8,7 +8,7 @@
 # r8..<round> — see tools/tpu_followup.sh). Gives up after ~11 h.
 # Usage: bash tools/tpu_poller.sh <round>
 set -u
-ROUND=${1:?usage: tpu_poller.sh <round: 4..16>}
+ROUND=${1:?usage: tpu_poller.sh <round: 4..17>}
 cd "$(dirname "$0")/.."
 probe() { timeout 2 bash -c '</dev/tcp/127.0.0.1/8082' 2>/dev/null; }
 deadline=$(( $(date +%s) + 39600 ))
