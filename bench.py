@@ -50,7 +50,7 @@ from pytorch_ddp_template_tpu.obs.attribution import (  # noqa: E402
     PEAK_FLOPS, cost_of,
 )
 
-MODE = os.environ.get("BENCH_MODE", "train")  # train | e2e | scaling | flash | compile | overlap | comms | tp | overlap3d | obs | perf | fleet | mem | pipe | quant
+MODE = os.environ.get("BENCH_MODE", "train")  # train | e2e | scaling | flash | compile | overlap | comms | tp | overlap3d | obs | perf | fleet | mem | pipe | quant | elastic
 MODEL = os.environ.get("BENCH_MODEL", "resnet50")
 WARMUP_STEPS = int(os.environ.get("BENCH_WARMUP", "5"))
 TIMED_STEPS = int(os.environ.get("BENCH_STEPS", "30"))
@@ -269,7 +269,7 @@ def run_bench(model: str, metric: str, unit: str, baseline: float,
     per_device = PER_DEVICE_BATCH or default_batch(model)
     devices = list(devices if devices is not None else jax.devices())
     n_dev = len(devices)
-    # decomposed-TP train leg (tools/tpu_followup_r10.sh): carve a model
+    # decomposed-TP train leg (tools/tpu_followup.sh 10): carve a model
     # axis off the mesh; per-device batch then means per data-shard
     tp_overlap = os.environ.get("BENCH_TP_OVERLAP", "") == "1"
     tp_size = int(os.environ.get("BENCH_TP", "2")) if tp_overlap else 1
@@ -320,14 +320,14 @@ def run_bench(model: str, metric: str, unit: str, baseline: float,
             )
         task.model = task.model.clone(scan_layers=True)
     ddp_overlap = os.environ.get("BENCH_DDP_OVERLAP", "") == "1"
-    if ddp_overlap:  # compressed-DDP train leg (tools/tpu_followup_r9.sh)
+    if ddp_overlap:  # compressed-DDP train leg (tools/tpu_followup.sh 9)
         if not scan:
             raise ValueError("BENCH_DDP_OVERLAP=1 needs BENCH_SCAN=1 "
                              "(the stacked layout is the schedule's unit)")
         task.model = task.model.clone(
             ddp_overlap=True, mesh=mesh,
             grad_comm=os.environ.get("BENCH_GRAD_COMM", "fp32"))
-    if tp_overlap:  # decomposed-TP train leg (tools/tpu_followup_r10.sh)
+    if tp_overlap:  # decomposed-TP train leg (tools/tpu_followup.sh 10)
         if not scan:
             raise ValueError("BENCH_TP_OVERLAP=1 needs BENCH_SCAN=1 "
                              "(the scanned block is the ring's unit)")
@@ -753,7 +753,7 @@ def run_overlap() -> dict:
     prefetch-pipelined execution of the same scanned, FSDP-sharded stack.
 
     Three legs, sized for what THIS host can prove (the real v5e step-time
-    pair rides in tools/tpu_followup_r8.sh):
+    pair rides in tools/tpu_followup.sh 8):
 
     - **bit-parity**: one optimizer step from identical init on both
       paths; records the losses and the max-abs param divergence (layer-
@@ -925,7 +925,7 @@ def run_comms() -> dict:
     overlapped/compressed reduce on the same scanned, replicated stack.
 
     Four legs, sized for what THIS host can prove (the real multi-chip
-    step-time pair rides in tools/tpu_followup_r9.sh):
+    step-time pair rides in tools/tpu_followup.sh 9):
 
     - **bit-parity + neutrality**: one optimizer step from identical init
       under ``--grad_comm fp32`` on the plain-scan baseline vs the
@@ -1175,7 +1175,7 @@ def run_tp() -> dict:
     ``data x model`` mesh.
 
     Five legs, sized for what THIS host can prove (the real multi-chip
-    step-time pair rides in tools/tpu_followup_r10.sh):
+    step-time pair rides in tools/tpu_followup.sh 10):
 
     - **bit/last-ulp parity**: one optimizer step from identical init on
       the GSPMD-default fused-head path vs the ring path (records loss
@@ -1419,7 +1419,7 @@ def run_overlap3d() -> dict:
     same ``data × model`` mesh.
 
     Legs, sized for what THIS host can prove (the real multi-chip pair
-    rides in tools/tpu_followup_r11.sh):
+    rides in tools/tpu_followup.sh 11):
 
     - **parity**: one optimizer step from identical init, composed vs
       default (loss delta + max param divergence; ring reassociation +
@@ -1838,7 +1838,7 @@ def run_perf() -> dict:
     arithmetically honest in what it reports.
 
     Legs, sized for what THIS host can prove (real-MFU numbers ride
-    tools/tpu_followup_r13.sh):
+    tools/tpu_followup.sh 13):
 
     - **neutrality**: the FULL production loop (``Trainer.train()`` —
       annotations, goodput accounting, perf snapshots at the logging
@@ -2036,7 +2036,7 @@ def run_fleet() -> dict:
     tripwires.
 
     Legs, sized for what THIS host can prove (real multi-host exchange
-    rides tools/tpu_followup_r14.sh; on one process the allgather is
+    rides tools/tpu_followup.sh 14; on one process the allgather is
     skipped by construction, so this record pins the full code path
     minus the wire):
 
@@ -3208,6 +3208,312 @@ def run_quant() -> dict:
     }
 
 
+def run_elastic() -> dict:
+    """Elastic-fleet proof (round 18, ``checkpoint/hot.py`` +
+    ``checkpoint/reshard.py`` + ``train/supervisor.py``): hot snapshots
+    must be ~free on the step clock, must strictly beat durable-only on
+    MTTR and lost work when a crash lands, and the fallback paths
+    (corrupt hot generation, partially-written durable step) must
+    restore through the production path, not refuse.
+
+    Legs, sized for what THIS host can prove (a real multi-host
+    preemption drill — SIGTERM one worker, resume on fewer chips —
+    rides ``tools/tpu_followup.sh legs_r18``):
+
+    - **neutrality**: the FULL production loop with
+      ``--hot_save_steps`` ON (cadence ``BENCH_HOT_EVERY``, default 5)
+      vs off, same model/batch/mesh, alternating fresh-run reps;
+      ``value`` = plain/hot ratio of the POOLED-median honest step
+      time (per-rep means are not comparable on a shared CPU host —
+      clock wander between reps exceeds the effect being measured);
+      the 0.9 band carries the headline. The hot tier's actual cost is
+      booked to the ``hot_checkpoint_save`` goodput bucket and
+      recorded separately, and the snapshot interval plus its
+      writeback-bleed successor are discarded from the timer —
+      neutrality on the step clock plus a visible, bounded side-work
+      bill is the design point.
+    - **MTTR + lost steps**: two subprocess episodes of
+      ``--inject_fault crash:K`` (hard ``os._exit`` after step K's
+      saves) followed by an auto-resume — one durable-only
+      (``--save_steps 8``), one with ``--hot_save_steps 2`` layered
+      under the same durable cadence. MTTR is kill→first-productive-
+      step measured from the resume process spawn to the first NEW
+      progress record; lost steps = K - resume point. The hot episode
+      must be strictly below durable-only on both, and its resume must
+      log ``restored from hot snapshot``.
+    - **fault fallbacks**: ``corrupt-hot-snapshot`` through a real run
+      (the byte-flipped newest generation fails CRC validation and
+      restore falls back) and a truncated newest durable step dir
+      (restore walks back to the latest COMPLETE step) — both through
+      ``restore_or_init``, the production path.
+
+    Knobs: BENCH_MODEL (default gpt-tiny — big enough state that the
+    durable-vs-hot restore cost difference is visible over process
+    noise), BENCH_BATCH, BENCH_STEPS/BENCH_WARMUP, BENCH_OUTPUT.
+    """
+    import json as _json
+    import shutil
+    import subprocess
+    from pathlib import Path
+
+    import jax
+
+    from pytorch_ddp_template_tpu.config import TrainingConfig
+    from pytorch_ddp_template_tpu.models import build
+    from pytorch_ddp_template_tpu.runtime import init as rt_init
+    from pytorch_ddp_template_tpu.train.engine import Trainer
+
+    model = os.environ.get("BENCH_MODEL") or "gpt-tiny"
+    # batch 4: steps slow enough that the durable tier's replayed lost
+    # steps (up to save_steps-1 of them) dominate the MTTR comparison
+    # over process-startup jitter
+    per_device = PER_DEVICE_BATCH or 4
+    n_dev = len(jax.devices())
+    platform = jax.devices()[0].platform
+    global_batch = per_device * n_dev
+    out_base = os.environ.get("BENCH_OUTPUT", "/tmp/bench_elastic")
+    total_steps = WARMUP_STEPS + TIMED_STEPS
+    repo = os.path.dirname(os.path.abspath(__file__))
+
+    base_cfg = dict(
+        model=model, mesh=f"data:{n_dev}",
+        per_device_train_batch_size=per_device,
+        dataset_size=max(global_batch * (total_steps + 2), 512),
+        warmup_steps=0, max_grad_norm=1000.0, max_steps=total_steps,
+        logging_steps=0, save_steps=0, resume=False,
+    )
+    ctx = rt_init(TrainingConfig(**base_cfg, output_dir=out_base + "_init"))
+
+    def build_trainer(kind: str, rep, **extra):
+        cfg = TrainingConfig(**{**base_cfg,
+                                "output_dir": f"{out_base}_{kind}_{rep}",
+                                **extra})
+        shutil.rmtree(cfg.output_dir, ignore_errors=True)
+        task, ds = build(model, cfg, mesh=ctx.mesh)
+        return Trainer(cfg, ctx, task, ds)
+
+    # -- neutrality leg: alternating fresh-run reps, min-of-reps ----------
+    # cadence 5 (BENCH_HOT_EVERY): snapshot cost sets the cadence
+    # (CheckFreq's point) — every-2 is the deterministic-test setting,
+    # not a production posture, and on a ~100ms-step model it would
+    # resync the bounded dispatch pipeline every other step
+    hot_every = int(os.environ.get("BENCH_HOT_EVERY", "5"))
+    # pooled-median estimator: this host's run-to-run clock wander
+    # (~±15% on shared CPU) dwarfs the hot tier's per-step effect, so
+    # per-rep means are not comparable — pool every honest (non-
+    # discarded) step sample across alternating reps and compare the
+    # medians instead
+    samples: dict[str, list[float]] = {"plain": [], "hot": []}
+    hot_save_s = 0.0
+    hot_generations = 0
+    import numpy as _np
+    for rep in range(3):
+        for kind in ("plain", "hot"):
+            extra = {"hot_save_steps": hot_every} if kind == "hot" else {}
+            trainer = build_trainer(kind, rep, **extra)
+            trainer.train()
+            trainer.ckpt.close()
+            samples[kind].extend(1e3 * t
+                                 for t in trainer.step_timer._times)
+            if kind == "hot":
+                gp = _json.loads(
+                    (Path(trainer.config.output_dir) / "goodput.json")
+                    .read_text())
+                hot_save_s = max(hot_save_s,
+                                 gp["buckets"]["hot_checkpoint_save"])
+                hot_generations = len(trainer.hot.generations())
+    if not samples["plain"] or not samples["hot"]:
+        raise RuntimeError("timed window produced no step samples")
+    step_ms = {k: float(_np.median(v)) for k, v in samples.items()}
+    ratio = step_ms["plain"] / max(step_ms["hot"], 1e-9)
+    if hot_generations == 0:
+        raise RuntimeError("hot variant wrote no generations — the hot "
+                           "tier never ran; the neutrality pair proves "
+                           "nothing")
+
+    # -- MTTR + lost-steps episodes (subprocess: the crash is os._exit) ---
+    # crash at 23 against --save_steps 8: the durable tier is 7 steps
+    # stale, the hot tier (cadence 2) 1 step — MTTR is kill→first
+    # FRONTIER-ADVANCING step (the first step that produces work the
+    # killed attempt had not already done), so the replayed lost steps
+    # are priced into it, not just the restore read
+    crash_step = 23
+    episode_steps = 40
+    env = dict(os.environ)
+    if platform == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={n_dev}")
+
+    def ddp_args(outdir: str, *extra: str) -> list[str]:
+        return [sys.executable, "-u", os.path.join(repo, "ddp.py"),
+                "--model", model, "--mesh", f"data:{n_dev}",
+                "--per_device_train_batch_size", str(per_device),
+                "--dataset_size", str(base_cfg["dataset_size"]),
+                "--max_steps", str(episode_steps), "--logging_steps", "1",
+                "--save_steps", "8", "--seed", "7",
+                "--output_dir", outdir, *extra]
+
+    def resume_once(crashdir: str, rep: int, *extra: str) -> dict:
+        """Copy the crashed dir (a resume mutates it) and time the
+        resume: MTTR = spawn → first metrics record whose step ADVANCES
+        past the crash frontier."""
+        outdir = f"{crashdir}_resume_{rep}"
+        shutil.rmtree(outdir, ignore_errors=True)
+        shutil.copytree(crashdir, outdir)
+        metrics = Path(outdir) / "metrics.jsonl"
+        offset = metrics.stat().st_size if metrics.is_file() else 0
+        t_spawn = time.perf_counter()
+        proc = subprocess.Popen(
+            ddp_args(outdir, *extra), env=env, cwd=repo,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        mttr_s = None
+        deadline = time.time() + 540
+        try:
+            while time.time() < deadline:
+                if metrics.is_file() and metrics.stat().st_size > offset:
+                    with open(metrics) as f:
+                        f.seek(offset)
+                        fresh = f.read().splitlines()
+                    recs = []
+                    for l in fresh:  # last line may be torn mid-write
+                        try:
+                            recs.append(_json.loads(l))
+                        except ValueError:
+                            pass
+                    if any("loss" in r and r.get("step", 0) > crash_step
+                           for r in recs):
+                        mttr_s = time.perf_counter() - t_spawn
+                        break
+                if proc.poll() is not None:
+                    break
+                time.sleep(0.05)
+            out, _ = proc.communicate(timeout=540)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        if mttr_s is None:
+            raise RuntimeError(
+                f"resume of {crashdir} never advanced past step "
+                f"{crash_step}:\n{(out or '')[-2000:]}")
+        describe = _json.loads((Path(outdir) / "describe.json").read_text())
+        gp = _json.loads((Path(outdir) / "goodput.json").read_text())
+        return {
+            "mttr_s": mttr_s,
+            "resume_step": describe["resumed_at_step"],
+            "attempt": describe["attempt"],
+            "restore_s": gp["buckets"]["restore"],
+            "halted_s": gp["buckets"]["halted"],
+            "hot_restore": "restored from hot snapshot" in (out or ""),
+        }
+
+    def episode(kind: str, *extra: str) -> dict:
+        crashdir = f"{out_base}_mttr_{kind}"
+        shutil.rmtree(crashdir, ignore_errors=True)
+        crashed = subprocess.run(
+            ddp_args(crashdir, "--inject_fault", f"crash:{crash_step}",
+                     *extra),
+            env=env, cwd=repo, capture_output=True, text=True, timeout=600)
+        if crashed.returncode != 137:
+            raise RuntimeError(
+                f"{kind} crash leg exited rc={crashed.returncode} "
+                f"(expected the injected 137):\n{crashed.stderr[-2000:]}")
+        # min-of-2 resume reps (each from a fresh copy of the crashed
+        # dir): interpreter + compile startup jitter is the noise floor
+        # the MTTR comparison must not drown in
+        reps = [resume_once(crashdir, rep, *extra) for rep in range(2)]
+        best = min(reps, key=lambda r: r["mttr_s"])
+        best["lost_steps"] = crash_step - best["resume_step"]
+        return best
+
+    durable = episode("durable")
+    hot = episode("hot", "--hot_save_steps", "2")
+
+    # -- fault-fallback legs (production restore path) --------------------
+    from pytorch_ddp_template_tpu.checkpoint.hot import (
+        HotCheckpointManager,
+    )
+
+    t = build_trainer("corrupt", 0, max_steps=6, save_steps=6,
+                      hot_save_steps=2,
+                      inject_fault="corrupt-hot-snapshot:4")
+    t.train()
+    t.ckpt.close()
+    # gen@6 is newest and valid; gen@4 was byte-flipped in place. Drop
+    # gen@6 so the restore faces the corrupt generation directly
+    hotm = HotCheckpointManager(f"{out_base}_corrupt_0")
+    shutil.rmtree(hotm.generations()[-1][2])
+    rec = hotm.latest_valid()
+    corrupt_detected = rec is None or rec.step < 4
+    # rebuild WITHOUT build_trainer (it wipes the output dir): the
+    # corrupt run's artifacts are the input
+    cfg2 = TrainingConfig(**{**base_cfg, "max_steps": 6, "save_steps": 6,
+                             "resume": True, "hot_save_steps": 2,
+                             "output_dir": f"{out_base}_corrupt_0"})
+    task2, ds2 = build(model, cfg2, mesh=ctx.mesh)
+    t2 = Trainer(cfg2, ctx, task2, ds2)
+    _, start = t2.restore_or_init()
+    t2.ckpt.close()
+    # the corrupt generation never validates; durable step 6 restores
+    corrupt_fallback_ok = corrupt_detected and start == 6
+
+    t3 = build_trainer("partial", 0, max_steps=8, save_steps=4)
+    t3.train()
+    t3.ckpt.close()
+    for f in (Path(f"{out_base}_partial_0") / "checkpoint_8"
+              / "state").rglob("*"):
+        if f.is_file() and f.stat().st_size > 256:
+            f.write_bytes(b"\0")
+    cfg4 = TrainingConfig(**{**base_cfg, "max_steps": 8, "save_steps": 4,
+                             "resume": True,
+                             "output_dir": f"{out_base}_partial_0"})
+    task4, ds4 = build(model, cfg4, mesh=ctx.mesh)
+    t4 = Trainer(cfg4, ctx, task4, ds4)
+    _, start4 = t4.restore_or_init()
+    t4.ckpt.close()
+    partial_fallback_ok = start4 == 4  # fell back past the torn step 8
+
+    return {
+        "metric": "elastic_hot_overhead_ratio",
+        "value": round(ratio, 3),
+        # hot snapshots every 2 steps vs off, full production loop; the
+        # 0.9 band carries the headline (cost lives in the
+        # hot_checkpoint_save bucket, off the step clock)
+        "unit": "x_plain_step_time",
+        "vs_baseline": round(ratio / 0.9, 4),
+        "platform": platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "n_devices": n_dev,
+        "n_processes": jax.process_count(),
+        "model": model,
+        "global_batch": global_batch,
+        "timed_steps": TIMED_STEPS,
+        "step_time_plain_ms": round(step_ms["plain"], 3),
+        "step_time_hot_ms": round(step_ms["hot"], 3),
+        "hot_save_bucket_s": round(hot_save_s, 4),
+        "hot_generations_kept": hot_generations,
+        # MTTR episodes: hot strictly below durable-only on both counts
+        "crash_step": crash_step,
+        "mttr_durable_s": round(durable["mttr_s"], 3),
+        "mttr_hot_s": round(hot["mttr_s"], 3),
+        "mttr_hot_below_durable": hot["mttr_s"] < durable["mttr_s"],
+        "lost_steps_durable": durable["lost_steps"],
+        "lost_steps_hot": hot["lost_steps"],
+        "lost_steps_hot_below_durable":
+            hot["lost_steps"] < durable["lost_steps"],
+        "resume_step_durable": durable["resume_step"],
+        "resume_step_hot": hot["resume_step"],
+        "restore_s_durable": round(durable["restore_s"], 3),
+        "restore_s_hot": round(hot["restore_s"], 3),
+        "hot_resume_used_hot_snapshot": hot["hot_restore"],
+        "resume_attempt": hot["attempt"],
+        "halted_booked_s": round(hot["halted_s"], 3),
+        # fault fallbacks through the production restore path
+        "corrupt_snapshot_fallback_ok": corrupt_fallback_ok,
+        "partial_save_fallback_ok": partial_fallback_ok,
+    }
+
+
 def run_scaling(model: str) -> dict:
     """DDP scaling sweep: per-chip throughput on data:1/2/4/... sub-meshes.
 
@@ -3417,6 +3723,8 @@ def main() -> None:
             _emit(run_pipe())
         elif MODE == "quant":
             _emit(run_quant())
+        elif MODE == "elastic":
+            _emit(run_elastic())
         elif MODE == "e2e":
             _emit(run_e2e(model, metric, unit, baseline))
         elif MODE == "train":
@@ -3425,7 +3733,7 @@ def main() -> None:
             raise ValueError(
                 f"unknown BENCH_MODE {MODE!r}; expected "
                 "train|e2e|scaling|flash|compile|overlap|comms|tp|"
-                "overlap3d|obs|perf|fleet|mem|pipe|quant"
+                "overlap3d|obs|perf|fleet|mem|pipe|quant|elastic"
             )
     except KeyboardInterrupt:  # operator abort is not a value-0 datum
         raise

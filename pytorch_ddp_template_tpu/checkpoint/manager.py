@@ -116,15 +116,55 @@ class CheckpointManager:
 
     def read_config(self, step: int | None = None) -> dict | None:
         """Read just the JSON config of a checkpoint (no state restore) —
-        used to validate template compatibility before StandardRestore."""
-        if step is None:
-            step = self.latest_step()
-        if step is None:
+        used to validate template compatibility before StandardRestore.
+        ``step=None`` walks steps newest-first past partially-written
+        dirs (the restore fallback below does the same for the state)."""
+        def attempt(s: int):
+            restored = self._mngr.restore(
+                s, args=ocp.args.Composite(config=ocp.args.JsonRestore()))
+            return restored["config"]
+
+        if step is not None:
+            return attempt(step)
+        try:
+            return self._try_steps(None, attempt)
+        except Exception:  # noqa: BLE001 - no step has a readable
+            #               config: the caller proceeds template-first
             return None
-        restored = self._mngr.restore(
-            step, args=ocp.args.Composite(config=ocp.args.JsonRestore())
-        )
-        return restored["config"]
+
+    def _fallback_steps(self, step: int | None) -> list[int]:
+        """The steps a restore may try: the explicit one alone, or —
+        ``step=None`` (auto-latest) — every step newest-first, so a
+        partially-written dir (crash mid-save) degrades to the latest
+        COMPLETE step instead of killing the resume (r18 satellite)."""
+        if step is not None:
+            return [step]
+        steps = sorted(self._mngr.all_steps(), reverse=True)
+        if not steps:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        return steps
+
+    def _try_steps(self, step: int | None, attempt):
+        """Run ``attempt(step)`` over :meth:`_fallback_steps`, logging and
+        skipping steps that fail; re-raises the NEWEST step's error when
+        none restores (a model mismatch fails every step identically —
+        the caller's named refusal must surface, not the oldest copy)."""
+        first_exc: Exception | None = None
+        steps = self._fallback_steps(step)
+        for i, s in enumerate(steps):
+            try:
+                return attempt(s)
+            except Exception as exc:  # noqa: BLE001 - fall back, rethrow
+                if first_exc is None:
+                    first_exc = exc
+                if i + 1 < len(steps):
+                    log.warning(
+                        "checkpoint step %s failed to restore "
+                        "(%s: %s) — likely a partially-written save from "
+                        "a crash mid-write; falling back to step %s",
+                        s, type(exc).__name__, exc, steps[i + 1])
+        assert first_exc is not None
+        raise first_exc
 
     def restore_raw(self, step: int | None = None) -> tuple[int, Any, dict]:
         """Template-free restore: ``(step, state_pytree, config_dict)`` with
@@ -133,30 +173,77 @@ class CheckpointManager:
         The checkpoint-conversion path (``tools/convert_checkpoint.py``
         restacking between the unrolled ``layer_{i}`` and the scanned
         stacked-layer layouts) needs the tree as stored — a template would
-        impose the *destination* structure and defeat the conversion."""
-        if step is None:
-            step = self.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints under {self.directory}")
-        restored = self._mngr.restore(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(),
-                config=ocp.args.JsonRestore(),
-            ),
-        )
-        return step, restored["state"], restored["config"]
+        impose the *destination* structure and defeat the conversion.
+        ``step=None`` falls back past partially-written step dirs."""
+
+        def attempt(s: int):
+            restored = self._mngr.restore(
+                s,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(),
+                    config=ocp.args.JsonRestore(),
+                ),
+            )
+            return s, restored["state"], restored["config"]
+
+        return self._try_steps(step, attempt)
+
+    def restore_resharded(self, step: int | None,
+                          template_state: Any) -> tuple[Any, dict]:
+        """Reshard-on-restore (r18): restore ``(state, config_dict)``
+        through the template-free path, converting the layer layout
+        in-process (scanned ↔ unrolled ↔ pipelined restacking — the
+        ``tools/convert_checkpoint.py`` core) and placing every leaf
+        onto the template's shardings, so a run restarted on a
+        different chip count / mesh shape / layer layout restores
+        directly instead of refusing. The whole state materialises on
+        host once (the converter's contract); genuinely lossy
+        mismatches still refuse with the leaf named."""
+        from .reshard import place_state_onto_template
+
+        step, raw, cfg = self.restore_raw(step)
+        raw_res = None
+        if _split_residual(template_state)[1] is not None:
+            try:
+                r = self._mngr.restore(
+                    step,
+                    args=ocp.args.Composite(
+                        residual=ocp.args.StandardRestore()))
+                raw_res = r["residual"]
+            except Exception as exc:  # noqa: BLE001 - best-effort state
+                log.warning(
+                    "checkpoint has no comm_residual item "
+                    f"({type(exc).__name__}); error-feedback residual "
+                    "zero-initialised")
+        state = place_state_onto_template(template_state, raw, raw_res)
+        self._warn_rng_stream(cfg)
+        log.info("checkpoint restored (resharded)", {"step": step})
+        return state, cfg
+
+    def _warn_rng_stream(self, cfg: Any) -> None:
+        from .. import native
+
+        saved_native = cfg.get("_native_rng") if isinstance(cfg, dict) else None
+        if saved_native is not None and saved_native != native.available():
+            log.warning(
+                "checkpoint was written with a different RNG stream "
+                "(native=%s, now=%s); resumed data order will not exactly "
+                "replay the interrupted epoch",
+                saved_native, native.available(),
+            )
 
     def restore(self, step: int | None, template_state: Any) -> tuple[Any, dict]:
-        """Restore ``(state, config_dict)``; ``step=None`` → latest.
+        """Restore ``(state, config_dict)``; ``step=None`` → latest
+        COMPLETE step (partially-written dirs from a crash mid-save are
+        logged and skipped — the r18 fallback).
 
         ``template_state`` supplies the pytree structure/shardings so arrays
         are restored directly onto their mesh placement.
         """
-        if step is None:
-            step = self.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        return self._try_steps(
+            step, lambda s: self._restore_at(s, template_state))
+
+    def _restore_at(self, step: int, template_state: Any) -> tuple[Any, dict]:
         body_tmpl, res_tmpl = _split_residual(template_state)
         restored = self._mngr.restore(
             step,
@@ -190,16 +277,7 @@ class CheckpointManager:
                         {"step": step, "reason": f"{type(exc).__name__}"},
                     )
         cfg = restored["config"]
-        from .. import native
-
-        saved_native = cfg.get("_native_rng") if isinstance(cfg, dict) else None
-        if saved_native is not None and saved_native != native.available():
-            log.warning(
-                "checkpoint was written with a different RNG stream "
-                "(native=%s, now=%s); resumed data order will not exactly "
-                "replay the interrupted epoch",
-                saved_native, native.available(),
-            )
+        self._warn_rng_stream(cfg)
         log.info("checkpoint restored", {"step": step})
         return state, cfg
 

@@ -1,0 +1,324 @@
+"""Reshard-on-restore: map a raw checkpoint tree onto a live template.
+
+Before r18 a checkpoint could only restore into the exact run shape that
+wrote it: same layer layout (scanned / unrolled / pipelined stage
+count), and any mismatch was a named refusal pointing at the offline
+``tools/convert_checkpoint.py``. That posture is wrong for an elastic
+fleet — the whole point of restarting on whatever capacity survives a
+preemption is that the surviving shape is *different* — so this module
+runs the converter logic *inside* restore:
+
+1. **Layout detection + conversion** — the raw (template-free) state
+   tree's layer layout is detected (``parallel/stacking``) and, when it
+   differs from the template's, converted in-process with the same
+   ``convert_tree_layout`` core the offline tool uses. Bit-exact: the
+   conversions are pure restacking reshapes.
+2. **Placement** — the converted tree is walked *in parallel with the
+   template* and every leaf is ``device_put`` onto the template leaf's
+   sharding. This is what makes a different chip count / mesh shape
+   restore work: the template was built for the CURRENT mesh, so
+   placement IS the reshard (orbax does the same thing natively when
+   layouts agree; this path extends it to layout changes and to hot
+   snapshots, which are raw host trees by construction).
+3. **EF-residual re-bucketing** — a saved ``(L, data_old, padded_old)``
+   error-feedback residual re-buckets onto the new data degree
+   preserving the telescoping sum (``parallel/compress.
+   rebucket_residual``, float tolerance); incompatible layouts
+   zero-initialise with the long-standing warning instead of crashing.
+
+Genuinely lossy mismatches (a leaf whose shape cannot be reached by
+restacking — the model geometry or optimizer changed) still refuse, with
+the mismatching leaf path named: resharding must never silently
+truncate or broadcast state.
+
+The same walk serialises live states into pure host trees
+(:func:`to_pure` / :func:`from_pure_arrays`) for the hot-checkpoint
+layer (``checkpoint/hot.py``), so hot and durable snapshots restore
+through ONE placement path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import get_logger
+
+log = get_logger(__name__)
+
+#: marker key for an array leaf inside a pure tree (the index into the
+#: flat leaves list saved alongside)
+LEAF_KEY = "__leaf__"
+#: marker key for a non-array python literal (int/float/str/bool)
+LIT_KEY = "__lit__"
+
+
+def _is_array(x: Any) -> bool:
+    return hasattr(x, "shape") and hasattr(x, "dtype")
+
+
+def _rebuild_seq(tmpl: Any, children: list) -> Any:
+    """Reconstruct a sequence with converted children (NamedTuples —
+    live optax states — need splat construction)."""
+    if isinstance(tmpl, tuple) and hasattr(tmpl, "_fields"):
+        return type(tmpl)(*children)
+    return type(tmpl)(children)
+
+
+# -- pure-tree serialisation (the hot-checkpoint wire format) -------------
+
+def to_pure(tree: Any) -> tuple[Any, list[Any]]:
+    """``(pure, leaves)``: the tree re-spelled in JSON-able containers
+    (dicts / lists / ``{LEAF_KEY: i}`` markers / ``{LIT_KEY: v}``
+    literals) plus the array leaves in marker order. Dataclasses (flax
+    structs) become field dicts; NamedTuples and tuples become lists —
+    the *template* reimposes the concrete types on restore, so the wire
+    format stays schema-free."""
+    leaves: list[Any] = []
+
+    def walk(x: Any) -> Any:
+        if x is None:
+            return None
+        if isinstance(x, dict):
+            return {str(k): walk(v) for k, v in x.items()}
+        if isinstance(x, (list, tuple)):
+            return [walk(v) for v in x]
+        if _is_array(x):
+            leaves.append(x)
+            return {LEAF_KEY: len(leaves) - 1}
+        if dataclasses.is_dataclass(x) and not isinstance(x, type):
+            return {f.name: walk(getattr(x, f.name))
+                    for f in dataclasses.fields(x)}
+        if isinstance(x, (bool, int, float, str)):
+            return {LIT_KEY: x}
+        raise TypeError(
+            f"to_pure cannot serialise a {type(x).__name__} leaf — hot "
+            "snapshots carry arrays, containers and literals only")
+
+    return walk(tree), leaves
+
+
+def from_pure_arrays(pure: Any, arrays: list[Any]) -> Any:
+    """Substitute the flat ``arrays`` back into a :func:`to_pure` tree:
+    the result is plain dicts/lists with numpy leaves — exactly the
+    shape a template-free orbax restore produces, so the one
+    :func:`reshard_onto_template` walk serves both."""
+    if pure is None:
+        return None
+    if isinstance(pure, dict):
+        if set(pure) == {LEAF_KEY}:
+            return arrays[int(pure[LEAF_KEY])]
+        if set(pure) == {LIT_KEY}:
+            return pure[LIT_KEY]
+        return {k: from_pure_arrays(v, arrays) for k, v in pure.items()}
+    if isinstance(pure, (list, tuple)):
+        return [from_pure_arrays(v, arrays) for v in pure]
+    return pure
+
+
+# -- placement ------------------------------------------------------------
+
+def place_onto_template(tmpl: Any, tree: Any, path: str = "state") -> Any:
+    """Walk ``tmpl`` and ``tree`` in parallel, placing every ``tree``
+    leaf onto the corresponding template leaf's sharding (shape-checked;
+    dtype cast to the template's). The template dictates structure —
+    raw orbax/hot trees spell tuples as lists and structs as dicts, and
+    this walk maps them back. Mismatches raise with the leaf path
+    named."""
+    if tmpl is None:
+        return None
+    if tree is None and not jax.tree.leaves(tmpl):
+        # orbax's template-free restore spells empty containers (optax
+        # EmptyState / empty tuples) as None; the template's leafless
+        # structure is authoritative
+        return tmpl
+    if isinstance(tmpl, dict):
+        if not isinstance(tree, dict):
+            raise ValueError(
+                f"reshard-on-restore: {path} is a mapping in the template "
+                f"but a {type(tree).__name__} in the checkpoint")
+        missing = sorted(set(map(str, tmpl)) - set(map(str, tree)))
+        if missing:
+            raise ValueError(
+                f"reshard-on-restore: checkpoint lacks {path}/{missing[0]} "
+                "(and possibly more) — the model/optimizer geometry "
+                "changed since the save")
+        extra = sorted(set(map(str, tree)) - set(map(str, tmpl)))
+        if extra:
+            # symmetric refusal: dropping saved state on the floor is a
+            # silent truncation, exactly what this walk must never do
+            raise ValueError(
+                f"reshard-on-restore: checkpoint carries {path}/{extra[0]} "
+                "(and possibly more) that this run's model/optimizer does "
+                "not — the geometry changed since the save; resharding "
+                "must not silently drop saved state")
+        by_str = {str(k): v for k, v in tree.items()}
+        return {k: place_onto_template(v, by_str[str(k)], f"{path}/{k}")
+                for k, v in tmpl.items()}
+    if isinstance(tmpl, (list, tuple)):
+        if (isinstance(tmpl, tuple) and hasattr(tmpl, "_fields")
+                and isinstance(tree, dict)):
+            # orbax's template-free restore spells NamedTuples (optax
+            # states) as field-name dicts; reorder by the template's
+            # fields
+            missing = [f for f in tmpl._fields if f not in tree]
+            if missing:
+                raise ValueError(
+                    f"reshard-on-restore: checkpoint lacks "
+                    f"{path}/{missing[0]} — the optimizer state changed "
+                    "since the save")
+            extra = sorted(set(tree) - set(tmpl._fields))
+            if extra:
+                raise ValueError(
+                    f"reshard-on-restore: checkpoint carries "
+                    f"{path}/{extra[0]} that this run's optimizer state "
+                    "does not — the optimizer changed since the save; "
+                    "resharding must not silently drop saved state")
+            tree = [tree[f] for f in tmpl._fields]
+        if not isinstance(tree, (list, tuple)) or len(tree) != len(tmpl):
+            raise ValueError(
+                f"reshard-on-restore: {path} holds {len(tmpl)} entries in "
+                "the template but "
+                f"{len(tree) if isinstance(tree, (list, tuple)) else type(tree).__name__} "
+                "in the checkpoint")
+        children = [place_onto_template(t, v, f"{path}[{i}]")
+                    for i, (t, v) in enumerate(zip(tmpl, tree))]
+        return _rebuild_seq(tmpl, children)
+    if _is_array(tmpl):
+        if not (_is_array(tree) or isinstance(tree, (int, float, bool))):
+            raise ValueError(
+                f"reshard-on-restore: {path} is an array in the template "
+                f"but a {type(tree).__name__} in the checkpoint")
+        arr = np.asarray(tree)
+        if tuple(arr.shape) != tuple(tmpl.shape):
+            raise ValueError(
+                f"reshard-on-restore: leaf {path} has shape "
+                f"{tuple(arr.shape)} in the checkpoint but "
+                f"{tuple(tmpl.shape)} in this run's template — a "
+                "genuinely lossy mismatch (model geometry/optimizer "
+                "changed?); restacking cannot bridge it. Convert offline "
+                "with tools/convert_checkpoint.py or pass --no_resume")
+        if arr.dtype != tmpl.dtype:
+            arr = arr.astype(tmpl.dtype)
+        sharding = getattr(tmpl, "sharding", None)
+        if sharding is not None:
+            return jax.device_put(arr, sharding)
+        return jnp.asarray(arr)
+    if dataclasses.is_dataclass(tmpl) and not isinstance(tmpl, type):
+        if not isinstance(tree, dict):
+            raise ValueError(
+                f"reshard-on-restore: {path} is a {type(tmpl).__name__} "
+                f"in the template but a {type(tree).__name__} in the "
+                "checkpoint")
+        fields = {f.name: place_onto_template(getattr(tmpl, f.name),
+                                              tree[f.name],
+                                              f"{path}/{f.name}")
+                  for f in dataclasses.fields(tmpl)}
+        return type(tmpl)(**fields)
+    # scalar/other template leaf: keep the checkpoint's value verbatim
+    return tree
+
+
+# -- the reshard entrypoint -----------------------------------------------
+
+def reshard_onto_template(raw: Any, tmpl: Any, *,
+                          desc: str = "checkpoint") -> Any:
+    """Convert ``raw`` (a template-free host tree: orbax raw restore or
+    a hot snapshot) into the template's layer layout (when they differ)
+    and place every leaf onto the template's shardings. Returns the
+    fully placed tree; raises with intent on genuinely lossy
+    mismatches."""
+    from ..parallel.stacking import (
+        convert_tree_layout, detect_layer_layout, detect_pipe_stages,
+    )
+
+    src_pipe = detect_pipe_stages(raw)
+    src = "pipelined" if src_pipe else detect_layer_layout(raw)
+    dst_pipe = detect_pipe_stages(tmpl)
+    dst = "pipelined" if dst_pipe else detect_layer_layout(tmpl)
+    if (src, src_pipe) != (dst, dst_pipe) and src != "none":
+        log.info(
+            "reshard-on-restore: converting %s layer layout %s -> %s "
+            "in-restore (bit-exact restack; the offline "
+            "tools/convert_checkpoint.py run is no longer required)",
+            desc,
+            src if src_pipe is None else f"{src}({src_pipe} stages)",
+            dst if dst_pipe is None else f"{dst}({dst_pipe} stages)")
+        raw = convert_tree_layout(raw, dst, pipe_stages=dst_pipe,
+                                  strict=False)
+    return place_onto_template(tmpl, raw)
+
+
+def place_state_onto_template(template_state: Any, raw_body: Any,
+                              raw_residual: Any = None, *,
+                              desc: str = "checkpoint") -> Any:
+    """THE one placement path: map a template-free ``(body, residual)``
+    pair — a raw orbax restore or a hot snapshot — onto a live
+    ``template_state``. Converts the layer layout, places every leaf
+    onto the template's shardings, and maps the EF residual (direct /
+    re-bucketed / zero-init-with-warning). Both the durable
+    ``CheckpointManager.restore_resharded`` and the engine's hot-tier
+    restore call here, so a placement fix can never land in one tier
+    and miss the other."""
+    from .manager import _split_residual
+
+    body_tmpl, res_tmpl = _split_residual(template_state)
+    placed = reshard_onto_template(raw_body, body_tmpl, desc=desc)
+    if body_tmpl is template_state:
+        return placed  # non-dataclass tree (tools): no residual split
+    state = template_state.replace(**placed)
+    if res_tmpl is not None:
+        restored_res = (restore_residual_onto(res_tmpl, raw_residual)
+                        if raw_residual is not None else None)
+        if restored_res is not None:
+            state = state.replace(comm_residual=restored_res)
+        else:
+            log.warning(
+                "%s carries no compatible comm_residual — error-feedback "
+                "residual zero-initialised (expected for pre-residual "
+                "checkpoints or after changing --grad_comm/topology; "
+                "fresh runs recommended when changing comm settings)",
+                desc)
+    return state
+
+
+def restore_residual_onto(res_tmpl: Any, raw_res: Any) -> Any | None:
+    """Map a saved EF-residual tree onto the template residual: direct
+    placement when shapes agree, the telescoping-preserving re-bucketing
+    when only the data degree changed, ``None`` (caller keeps the zero
+    init) when the layouts are genuinely incompatible."""
+    from ..parallel.compress import rebucket_residual
+
+    tl = jax.tree.leaves(res_tmpl)
+    rl = (jax.tree.leaves(raw_res)
+          if not isinstance(raw_res, (list, tuple))
+          else list(raw_res))
+    if len(tl) != len(rl):
+        return None
+    placed = []
+    rebucketed = False
+    for t, r in zip(tl, rl):
+        r = np.asarray(r)
+        if tuple(r.shape) == tuple(t.shape):
+            pass
+        elif (r.ndim == 3 and t.ndim == 3
+              and r.shape[0] == t.shape[0]):
+            r = rebucket_residual(r, tuple(t.shape))
+            rebucketed = True
+        else:
+            return None
+        sharding = getattr(t, "sharding", None)
+        arr = r.astype(t.dtype)
+        placed.append(jax.device_put(arr, sharding)
+                      if sharding is not None else jnp.asarray(arr))
+    if rebucketed:
+        log.info(
+            "reshard-on-restore: error-feedback residual re-bucketed "
+            "onto the new data degree (telescoping sum preserved at "
+            "float tolerance; per-replica attribution reset)")
+    structure = jax.tree.structure(res_tmpl)
+    return jax.tree.unflatten(structure, placed)

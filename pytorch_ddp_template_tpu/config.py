@@ -169,6 +169,33 @@ class TrainingConfig:
     #                            the reference GCs nothing (ddp.py:254-277)
     eval_only: bool = False  # evaluate a checkpoint (no training); needs one
     resume: bool = True  # auto-resume from latest checkpoint in output_dir
+    hot_save_steps: int = 0  # hot-checkpoint cadence (checkpoint/hot.py):
+    #                          fast local-disk snapshots of the whole
+    #                          state every N steps, layered UNDER the
+    #                          durable orbax saves (atomic staging dir +
+    #                          generation counter + per-leaf CRCs; the
+    #                          newest VALID generation is preferred over
+    #                          an older durable step on restore, so a
+    #                          crash loses O(hot_save_steps) work instead
+    #                          of O(save_steps)). Cost booked to the
+    #                          goodput `hot_checkpoint_save` bucket.
+    #                          0 = off
+    supervise: str = "off"  # off | warn | act — supervisor policy
+    #                         (train/supervisor.py): confirmed
+    #                         straggler/mem-pressure verdicts from the
+    #                         r12/r14 sentry trigger checkpoint →
+    #                         evict-the-named-host → coordinated stop
+    #                         (the r6 device-side agreement) → resume on
+    #                         the healthy subset via reshard-on-restore.
+    #                         warn logs the would-be action only; every
+    #                         decision lands in supervisor.json and the
+    #                         goodput `evict_resume` bucket
+    inject_fault: str = ""  # deterministic fault injection
+    #                         "kind:step[:param]" with kind one of
+    #                         crash | hang-host | corrupt-hot-snapshot |
+    #                         slow-host (train/supervisor.FaultInjector)
+    #                         — drives the elastic stack in tests and
+    #                         BENCH_MODE=elastic; empty = off
     profile_steps: int = 0  # trace steps [10, 10+N) to output_dir/profile (SURVEY.md §5.1)
     divergence_check_steps: int = 0  # cross-host param fingerprint every N steps (§5.2)
     preempt_sync_steps: int = 8  # legacy (accepted, unused): SIGTERM agreement
@@ -428,6 +455,22 @@ class TrainingConfig:
                 "--logging_steps and --perf_every are 0 — set one of them "
                 "or drop --fleet (a cadence-less watchtower never fires)"
             )
+        if self.hot_save_steps < 0:
+            raise ValueError(
+                f"--hot_save_steps must be >= 0, got "
+                f"{self.hot_save_steps} (0 = off)")
+        if self.supervise not in ("off", "warn", "act"):
+            raise ValueError(
+                f"unknown --supervise {self.supervise!r}; expected "
+                "off | warn | act")
+        if self.inject_fault:
+            # fail a typo'd fault spec at parse time, not at the
+            # injection step hours into the run it was meant to test
+            # (lazy import: the supervisor module is jax-free, but the
+            # common no-fault construction should not pay any import)
+            from .train.supervisor import FaultInjector
+
+            FaultInjector.parse(self.inject_fault)
         if self.anomaly not in ("off", "warn", "halt"):
             raise ValueError(
                 f"unknown --anomaly {self.anomaly!r}; expected "
@@ -753,6 +796,34 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="Run the exactly-once eval on a saved checkpoint "
                         "(latest, or --global-step) and exit — no training.")
     p.add_argument("--no_resume", dest="resume", action="store_false")
+    p.add_argument("--hot_save_steps", type=int, default=0,
+                   help="Hot-checkpoint cadence (checkpoint/hot.py): "
+                        "snapshot the whole training state to local "
+                        "disk every N steps, layered under the durable "
+                        "orbax saves (atomic generation dirs, per-leaf "
+                        "CRCs; the newest VALID snapshot is preferred "
+                        "over an older durable step on restore, so a "
+                        "crash loses O(N) steps instead of "
+                        "O(save_steps)). Cost is booked to the goodput "
+                        "hot_checkpoint_save bucket. 0 = off.")
+    p.add_argument("--supervise", type=str, default="off",
+                   choices=["off", "warn", "act"],
+                   help="Supervisor policy (train/supervisor.py) over "
+                        "confirmed sentry verdicts: 'act' turns a "
+                        "straggler/mem-pressure verdict into checkpoint "
+                        "-> evict the named host -> coordinated stop "
+                        "(the r6 device-side agreement) -> resume on "
+                        "the healthy subset via reshard-on-restore; "
+                        "'warn' logs the would-be action only. Every "
+                        "decision lands in supervisor.json, /status "
+                        "and the goodput evict_resume bucket.")
+    p.add_argument("--inject_fault", type=str, default="",
+                   help="Deterministic fault injection 'kind:step"
+                        "[:param]', kind one of crash | hang-host | "
+                        "corrupt-hot-snapshot | slow-host — the "
+                        "elastic-stack test harness (fires after that "
+                        "step's save blocks; crash is a hard os._exit "
+                        "with no final save). Empty = off.")
     p.add_argument("--profile_steps", type=int, default=0,
                    help="Capture a profiler trace over N steps (from step 10).")
     p.add_argument("--divergence_check_steps", type=int, default=0,

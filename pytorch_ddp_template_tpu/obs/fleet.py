@@ -24,8 +24,11 @@ This module is that exchange, sized for this engine:
   single-process run skips the collective entirely, so the degenerate
   case costs a dict copy). Every process emits at the same cadence —
   the loop's logging boundary — so the collective is symmetric by
-  construction. A transport failure degrades to the local row and logs
-  once: the watchtower must never cost the run it watches.
+  construction. A transport failure retries with bounded backoff (the
+  step-keyed round protocol makes retries idempotent), then degrades to
+  the local row for THAT window only and re-probes on the next — a
+  transient coordinator blip must not blind the watchtower, and the
+  watchtower must never cost the run it watches.
 - **Aggregation** — the fleet table: per-signal min/median/max plus the
   per-host rows, kept as :attr:`FleetMonitor.latest_table` (served by
   ``obs/server.py`` under ``/status`` and ``/metrics``) and logged on
@@ -120,7 +123,18 @@ def decode_rows(rows: np.ndarray) -> list[dict[str, float]]:
 #: substituted), it must never wedge the drain thread with it
 KV_TIMEOUT_MS = 10_000
 
-_kv_round = 0
+#: bounded retry-with-backoff before one window degrades to local-only
+#: (r18 satellite): a transient coordinator blip must not blind the
+#: watchtower for even one window when a 50ms retry would have worked
+EXCHANGE_RETRIES = 2
+EXCHANGE_BACKOFF_S = 0.05
+
+#: rounds already exchanged, for best-effort store cleanup (the round
+#: NUMBER itself is the window's global step since r18 — identical on
+#: every host by SPMD construction, and stable across retries, so a
+#: retried set/gather is idempotent instead of desynchronising the
+#: fleet's round counters the way a per-call counter would)
+_done_rounds: list[int] = []
 
 
 def _default_exchange(vec: np.ndarray) -> np.ndarray:
@@ -136,14 +150,16 @@ def _default_exchange(vec: np.ndarray) -> np.ndarray:
     never touches a device. Single-process fleets are just this
     host's row (no jax.distributed involved at all).
 
-    Exchange protocol: round-numbered keys (every host emits at the
-    same cadence, so round counters agree), set-then-gather with a
-    bounded per-peer wait — a missing/laggard peer's row degrades to
-    this host's own values rather than stalling; rounds older than the
-    previous one are deleted best-effort so the store stays bounded."""
+    Exchange protocol: round-numbered keys — the round number is the
+    window's global STEP (identical on every host: fleet windows are
+    emitted at the same loop boundary), so a retried call re-sets the
+    same key idempotently instead of advancing a per-call counter out
+    of sync with the fleet. Set-then-gather with a bounded per-peer
+    wait — a missing/laggard peer's row degrades to this host's own
+    values rather than stalling; rounds older than the previous one
+    are deleted best-effort so the store stays bounded."""
     if process_count() == 1:
         return vec[None, :]
-    global _kv_round
     from jax._src import distributed
 
     client = distributed.global_state.client
@@ -151,8 +167,7 @@ def _default_exchange(vec: np.ndarray) -> np.ndarray:
         raise RuntimeError("jax.distributed client not initialised")
     me = process_index()
     n = process_count()
-    rnd = _kv_round
-    _kv_round += 1
+    rnd = int(vec[0])  # the window's step: fleet-agreed, retry-stable
     payload = ",".join(repr(float(x)) for x in vec)
     client.key_value_set(f"obs_fleet/{rnd}/{me}", payload)
     rows = []
@@ -175,9 +190,10 @@ def _default_exchange(vec: np.ndarray) -> np.ndarray:
         except Exception:  # noqa: BLE001 - a laggard peer degrades to
             #               this host's row, never a stalled drain
             rows.append(vec)
-    if rnd >= 2:  # bounded store: drop the round before last
+    _done_rounds.append(rnd)
+    if len(_done_rounds) > 2:  # bounded store: drop the round before last
         try:
-            client.key_value_delete(f"obs_fleet/{rnd - 2}/")
+            client.key_value_delete(f"obs_fleet/{_done_rounds.pop(0)}/")
         except Exception:  # noqa: BLE001 - cleanup is best-effort
             pass
     return np.stack(rows)
@@ -219,18 +235,40 @@ class FleetMonitor:
     # -- drain-thread side -------------------------------------------------
     def observe(self, step: int, window: dict[str, Any]) -> None:
         """Feed this host's window (telemetry ``kind="fleet"`` route);
-        exchanges, aggregates, detects. Never raises."""
+        exchanges, aggregates, detects. Never raises.
+
+        Transport discipline (r18 satellite): a failed exchange retries
+        ``EXCHANGE_RETRIES`` times with exponential backoff INSIDE this
+        window (the step-keyed round protocol makes retries idempotent)
+        before degrading to the local row; the degradation lasts this
+        window only — the next window re-probes, and a recovery clears
+        the degraded flag and says so, so a transient coordinator blip
+        never permanently blinds the watchtower."""
         try:
             vec = encode_window(window)
-            try:
-                rows = self._exchange(vec)
-            except Exception:  # noqa: BLE001 - transport down ≠ run down
-                if not self._exchange_failed:
-                    self._exchange_failed = True
-                    log.exception(
-                        "fleet exchange failed; watching this host only "
-                        "(logged once)")
+            rows = None
+            delay = EXCHANGE_BACKOFF_S
+            for attempt in range(EXCHANGE_RETRIES + 1):
+                try:
+                    rows = self._exchange(vec)
+                    break
+                except Exception:  # noqa: BLE001 - transport down ≠ run down
+                    if attempt < EXCHANGE_RETRIES:
+                        time.sleep(delay)
+                        delay *= 2
+                    elif not self._exchange_failed:
+                        self._exchange_failed = True
+                        log.exception(
+                            "fleet exchange failed after "
+                            f"{EXCHANGE_RETRIES + 1} attempts; watching "
+                            "this host only for this window (re-probing "
+                            "next window; logged once per episode)")
+            if rows is None:
                 rows = vec[None, :]
+            elif self._exchange_failed:
+                self._exchange_failed = False
+                log.info("fleet exchange recovered; cross-host "
+                         "aggregation resumed")
             hosts = decode_rows(rows)
             table = self.aggregate(hosts, step=int(step))
             self.exchanges += 1

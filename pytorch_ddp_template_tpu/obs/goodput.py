@@ -46,9 +46,15 @@ from ..utils.serialization import json_sanitize
 log = get_logger(__name__)
 
 #: every bucket the ledger tracks; ``goodput`` = productive_step over the
-#: sum of them all
-BUCKETS = ("productive_step", "compile", "checkpoint_save", "restore",
-           "input_stall", "eval", "halted", "other")
+#: sum of them all. r18 splits two elastic buckets out of their old
+#: homes: ``hot_checkpoint_save`` (the --hot_save_steps local-disk tier,
+#: previously indistinguishable inside ``checkpoint_save``) and
+#: ``evict_resume`` (downtime the SUPERVISOR chose — checkpoint → evict
+#: → resume — previously booked as generic ``halted`` preemption), so
+#: the supervisor's cost/benefit is readable straight off goodput.json
+BUCKETS = ("productive_step", "compile", "checkpoint_save",
+           "hot_checkpoint_save", "restore", "input_stall", "eval",
+           "halted", "evict_resume", "other")
 
 FILENAME = "goodput.json"
 
@@ -69,6 +75,12 @@ class GoodputLedger:
         #: finished run with a larger --max_steps days later is a
         #: workflow, not a preemption
         self.completed = False
+        #: set True by the supervisor when IT stopped the run
+        #: (checkpoint → evict → resume): the next attempt then books
+        #: the restart gap as ``evict_resume`` — a cost the supervisor
+        #: chose and must answer for — instead of generic ``halted``
+        #: preemption downtime
+        self.evicted = False
         prior = self._load_prior()
         if prior is not None:
             for b in BUCKETS:
@@ -96,7 +108,11 @@ class GoodputLedger:
                         "hosts/reboots?); booking 0s of halted downtime "
                         "for this restart instead of a negative gap")
                     gap = 0.0
-                self._prior["halted"] += gap
+                # a supervisor-chosen stop books its reschedule gap to
+                # its own bucket; organic preemption stays `halted`
+                bucket = ("evict_resume" if prior.get("evicted")
+                          else "halted")
+                self._prior[bucket] += gap
 
     def _load_prior(self) -> dict[str, Any] | None:
         try:
@@ -119,7 +135,8 @@ class GoodputLedger:
 
     def split_iteration(self, dt: float, *, input_s: float = 0.0,
                         compile_s: float = 0.0, save_s: float = 0.0,
-                        eval_s: float = 0.0, other_s: float = 0.0) -> None:
+                        hot_save_s: float = 0.0, eval_s: float = 0.0,
+                        other_s: float = 0.0) -> None:
         """Split one loop-iteration interval ``dt`` across buckets:
         measured components first (clamped so the sum never exceeds
         ``dt``), remainder productive."""
@@ -127,8 +144,9 @@ class GoodputLedger:
             return
         remaining = dt
         for bucket, s in (("input_stall", input_s), ("compile", compile_s),
-                          ("checkpoint_save", save_s), ("eval", eval_s),
-                          ("other", other_s)):
+                          ("checkpoint_save", save_s),
+                          ("hot_checkpoint_save", hot_save_s),
+                          ("eval", eval_s), ("other", other_s)):
             take = min(max(s, 0.0), remaining)
             if take > 0:
                 self._current[bucket] += take
@@ -174,6 +192,7 @@ class GoodputLedger:
             "schema": "goodput/v1",
             "attempt": self.attempt,
             "completed": bool(self.completed),
+            "evicted": bool(self.evicted),
             "goodput": (tot["productive_step"] / wall) if wall else None,
             "wall_s": wall,
             "buckets": tot,

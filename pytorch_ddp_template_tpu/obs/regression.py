@@ -150,6 +150,16 @@ class PerfBaseline:
         #: the previous attempt's fingerprint (None on a fresh dir)
         self.prior: dict[str, Any] | None = (
             self._doc.get("fingerprint") if self._doc else None)
+        # history as of THIS attempt's start: the prior doc's history
+        # plus its fingerprint. Snapshotted once so repeated write()
+        # calls within one attempt (r18: the fingerprint persists at
+        # the perf cadence so a CRASHED attempt still leaves a
+        # yardstick) stay idempotent instead of stuffing the bounded
+        # history with same-attempt snapshots
+        self._init_history: list[dict[str, Any]] = list(
+            (self._doc or {}).get("history", []))
+        if self.prior:
+            self._init_history.append(self.prior)
 
     def _load(self) -> dict[str, Any] | None:
         try:
@@ -171,13 +181,15 @@ class PerfBaseline:
 
     def write(self, fingerprint: dict[str, Any]) -> None:
         """Persist ``fingerprint`` as the new baseline (host 0, atomic,
-        best-effort); prior fingerprints are kept in a bounded history
-        so a slow drift across many attempts stays visible."""
+        best-effort); prior attempts' fingerprints are kept in a
+        bounded history so a slow drift across many attempts stays
+        visible. Idempotent within an attempt: the engine calls this at
+        the perf cadence once the timer is steady (so a hard-killed
+        attempt still leaves a yardstick — the elastic restart case)
+        and again at clean shutdown."""
         if not is_main_process():
             return
-        history = list((self._doc or {}).get("history", []))
-        if self._doc and self._doc.get("fingerprint"):
-            history.append(self._doc["fingerprint"])
+        history = list(self._init_history)
         payload = {
             "schema": "perf_baseline/v1",
             "fingerprint": fingerprint,

@@ -137,6 +137,24 @@ def prometheus_lines(snapshot: dict[str, Any]) -> str:
     if sentry:
         _gauge(lines, seen, prom_name("anomaly_triggered"),
                1.0 if sentry.get("triggered") else 0.0, {"host": host})
+    sup = snapshot.get("supervisor") or {}
+    if sup:
+        # the r18 supervisor: decision count + whether it has acted
+        # (checkpoint -> evict -> stop), with the eviction target as a
+        # label so an alert can name the drained host without parsing
+        # supervisor.json
+        _gauge(lines, seen, prom_name("supervisor_decisions_total"),
+               len(sup.get("decisions") or []), {"host": host},
+               help_="verdicts the supervisor evaluated (act or warn)")
+        _gauge(lines, seen, prom_name("supervisor_acted"),
+               1.0 if sup.get("acted") else 0.0, {"host": host})
+        ev = next((d for d in reversed(sup.get("decisions") or [])
+                   if d.get("action") == "evict" and d.get("acted")),
+                  None)
+        _gauge(lines, seen, prom_name("supervisor_eviction_active"),
+               0.0 if ev is None else 1.0,
+               {"host": host,
+                "evicted_host": "" if ev is None else str(ev.get("host"))})
     fleet = (snapshot.get("fleet") or {}).get("table") or {}
     for row in fleet.get("hosts") or []:
         h = str(int(row.get("host", 0)))

@@ -39,7 +39,7 @@ NEG_INF = -1e30  # additive mask value; finite so 0*inf NaNs can't appear
 # 1.34x/3.24x at 4096 — the win grows with seq, and at 1024 the full
 # (non-causal) case is already near parity. Below 1024 there is no
 # hardware record at all (flash@512 is queued in
-# tools/tpu_followup_r4.sh), so ``auto`` keeps the XLA path there until
+# tools/tpu_followup.sh 4), so ``auto`` keeps the XLA path there until
 # a committed record says otherwise.
 FLASH_MIN_SEQ = 1024
 

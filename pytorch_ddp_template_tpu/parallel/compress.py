@@ -210,6 +210,45 @@ def init_residual(stacked: Any, data_size: int, chunk: int = CHUNK, *,
     )
 
 
+def rebucket_residual(raw: np.ndarray,
+                      new_shape: tuple[int, ...]) -> np.ndarray:
+    """Re-bucket one saved EF-residual leaf ``(L, data_old, padded_old)``
+    onto a new data-parallel degree ``(L, data_new, padded_new)`` — the
+    r18 reshard-on-restore move for elastic restarts that change the
+    replica count.
+
+    What error feedback guarantees is the *telescoping sum*: the sum of
+    residuals over replicas is the gradient mass not yet applied. The
+    re-bucketing preserves exactly that invariant (float tolerance):
+    sum the per-replica residuals, resize the flat payload (the region
+    beyond the true element count is zero by construction — padding
+    positions quantize zero grads to zero error), and split the total
+    evenly across the new replicas. Per-replica attribution is NOT
+    preserved (it cannot be: the replicas no longer exist), which is
+    why this is a float-tolerance conversion, not a bit-exact one.
+    Only same-rank 3-d leaves with a matching layer count qualify; the
+    caller zero-initialises anything else (e.g. the 4-d ddp×tp layout,
+    whose per-model-shard bucketing does not survive a model-axis
+    change)."""
+    raw = np.asarray(raw, dtype=np.float32)
+    if raw.ndim != 3 or len(new_shape) != 3:
+        raise ValueError(
+            f"rebucket_residual handles (L, data, padded) leaves only, "
+            f"got {raw.shape} -> {tuple(new_shape)}")
+    if raw.shape[0] != new_shape[0]:
+        raise ValueError(
+            f"layer count changed {raw.shape[0]} -> {new_shape[0]}; the "
+            "residual cannot be re-bucketed across a layer-stack change")
+    _, d_new, p_new = new_shape
+    total = raw.sum(axis=1)  # (L, padded_old): the telescoping invariant
+    p_old = total.shape[1]
+    if p_new >= p_old:
+        total = np.pad(total, ((0, 0), (0, p_new - p_old)))
+    else:
+        total = total[:, :p_new]
+    return np.repeat((total / d_new)[:, None, :], d_new, axis=1)
+
+
 def _reduce_flat(flat: jax.Array, key: jax.Array | None, mode: str,
                  axis_name: str, n: int, chunk: int,
                  want_error: bool) -> tuple[jax.Array, jax.Array | None]:

@@ -288,6 +288,65 @@ def pipe_to_layer_stack(tree: Any) -> Any:
                        if getattr(a, "ndim", 0) >= 2 else a), v)})
 
 
+def convert_tree_layout(tree: Any, to: str,
+                        pipe_stages: int | None = None, *,
+                        strict: bool = True) -> Any:
+    """Restack a whole state pytree into layout ``to`` (``"scanned"`` /
+    ``"unrolled"`` / ``"pipelined"``) — the converter core shared by
+    ``tools/convert_checkpoint.py`` (offline) and the r18
+    reshard-on-restore path inside ``CheckpointManager`` (in-process).
+
+    ``strict=True`` (the CLI contract) refuses a no-op conversion and a
+    tree with no layer stack at all; ``strict=False`` (the restore
+    contract) returns such trees unchanged — a restore that needs no
+    conversion is a success, not an error.
+    """
+    pipe_p = detect_pipe_stages(tree)
+    have = "pipelined" if pipe_p else detect_layer_layout(tree)
+    if to == "pipelined":
+        if pipe_stages is None or pipe_stages < 2:
+            raise ValueError(
+                "--to pipelined needs --pipe_stages N (N >= 2): the "
+                "stage count of the target pipe mesh axis")
+        if have == "pipelined":
+            if pipe_stages == pipe_p:
+                if not strict:
+                    return tree
+                raise ValueError(
+                    f"checkpoint is already stacked for {pipe_p} "
+                    "pipeline stages; converting would be a no-op")
+            return repipe_stage_trees(tree, pipe_stages)
+        if have == "none":
+            raise ValueError(
+                "checkpoint holds no 'blocks' layer stack to split into "
+                "pipeline stages — pipelined layouts serve the gpt-pipe "
+                "entries only"
+            )
+        if have == "unrolled":
+            tree = restack_layer_trees(tree)
+        return layer_stack_to_pipe(tree, pipe_stages)
+    if have == "pipelined":
+        tree = pipe_to_layer_stack(tree)  # now the scanned spelling
+        return tree if to == "scanned" else unroll_layer_trees(tree)
+    if have == "none":
+        if not strict:
+            return tree  # MLP/ResNet states have no layer stack to move
+        raise ValueError(
+            "checkpoint holds no transformer layer stack (neither layer_{i} "
+            "subtrees nor a stacked 'layers' subtree) — nothing to convert; "
+            "--scan_layers applies to the transformer families only"
+        )
+    if have == to:
+        if not strict:
+            return tree
+        raise ValueError(
+            f"checkpoint is already in the {to} layout; converting would be "
+            "a no-op — point --src at the other layout or skip the step"
+        )
+    return (restack_layer_trees(tree) if to == "scanned"
+            else unroll_layer_trees(tree))
+
+
 def layer_stack_to_pipe(tree: Any, n_stages: int) -> Any:
     """Scanned → pipelined: split each blocks subtree's ``{"layers":
     (num_layers, ...)}`` stack into the raw ``(n_stages,

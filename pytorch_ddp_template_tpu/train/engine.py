@@ -368,11 +368,47 @@ class Trainer:
             config.output_dir,
             max_to_keep=config.keep_checkpoints or None,
         )
+        # hot-checkpoint tier (--hot_save_steps, checkpoint/hot.py):
+        # fast local-disk snapshots layered under the durable orbax
+        # saves; restore prefers the newest VALID hot generation over
+        # an older durable step. Built whenever the flag is on OR a
+        # prior attempt left snapshots behind (a restart without the
+        # flag must still restore from the freshest state available)
+        self.hot = None
+        from ..checkpoint.hot import DIRNAME as HOT_DIRNAME
+        from ..checkpoint.hot import HotCheckpointManager
+
+        if config.hot_save_steps or (Path(config.output_dir)
+                                     / HOT_DIRNAME).is_dir():
+            self.hot = HotCheckpointManager(config.output_dir)
+        # supervisor policy (--supervise, train/supervisor.py): the
+        # drain-thread verdict feeds (straggler/mem_pressure/regression)
+        # queue decisions; the loop polls and, in act mode, executes
+        # checkpoint -> evict -> coordinated stop
+        self.supervisor = None
+        if config.supervise != "off":
+            from .supervisor import Supervisor
+
+            self.supervisor = Supervisor(config.supervise,
+                                         config.output_dir)
+        # deterministic fault injection (--inject_fault): the elastic
+        # test harness; fires in the loop after the save blocks
+        from .supervisor import FaultInjector
+
+        self.fault = FaultInjector.parse(config.inject_fault)
+        self._supervisor_stop = False
         self.metrics_writer = MetricsWriter(config.output_dir)
         self.telemetry = make_telemetry(config.telemetry, self.metrics_writer)
         # shared with bench.py's e2e full-loop leg: steady-state step-time
         # percentiles with side-work intervals discarded
         self.step_timer = StepTimer()
+        # hot-save discard cooldown: the snapshot's blocking device_get
+        # drains the dispatch pipeline and its local-disk write keeps
+        # bleeding (OS writeback competes with compute — measurable on
+        # the CPU backend) for about one interval after the save
+        # returns, so the save interval AND the next are not
+        # steady-state step times
+        self._hot_discard = 0
         self.divergence = DivergenceMonitor(lag=max(config.max_inflight_steps, 1))
         # anomaly sentry + flight recorder (--anomaly warn|halt): the
         # sentry consumes the per-step health feed ON the telemetry drain
@@ -459,7 +495,7 @@ class Trainer:
         # wall-clock they are part of)
         self._pending: dict[str, float] = {
             "compile": 0.0, "checkpoint_save": 0.0,
-            "eval": 0.0, "other": 0.0}
+            "hot_checkpoint_save": 0.0, "eval": 0.0, "other": 0.0}
         # cumulative loop time spent blocked in the dispatch-depth
         # barrier's fence read — the device-wait measure the perf
         # attribution splits into compute vs comm
@@ -543,63 +579,181 @@ class Trainer:
         # init: a doomed restore should fail in milliseconds with its
         # intent message, not after a full model init + placement
         want = self.config.global_step if self.config.global_step > 0 else None
-        if want is not None and self.ckpt.latest_step() is None:
+        durable_latest = self.ckpt.latest_step()
+        if want is not None and durable_latest is None:
             # an explicit --global_step that cannot be honoured must not
             # silently restart from scratch
             raise FileNotFoundError(
                 f"--global_step {want} requested but no checkpoints exist "
                 f"under {self.ckpt.directory}"
             )
-        if (want is not None or self.config.resume) and self.ckpt.latest_step() is not None:
-            saved = self.ckpt.read_config(want) or {}
-            saved_opt = saved.get("optimizer")
-            if saved_opt is not None and saved_opt != self.config.optimizer:
-                # fail with intent, not an opaque orbax pytree mismatch:
-                # the opt_state template cannot match a different optimizer
-                raise ValueError(
-                    f"checkpoint at step {want or self.ckpt.latest_step()} was "
-                    f"trained with --optimizer {saved_opt}, current run uses "
-                    f"{self.config.optimizer}; pass --no_resume or a fresh "
-                    "--output_dir to start over"
-                )
-            # checkpoints from before the scan_layers flag existed lack
-            # the key and are necessarily unrolled — default False so they
-            # still get the actionable error under --scan_layers
-            saved_scan = saved.get("scan_layers", False)
-            if bool(saved_scan) != bool(self.config.scan_layers):
-                # same failure discipline for the layer layout: an
-                # unrolled layer_{i} tree cannot restore into a scanned
-                # (num_layers, ...)-stacked template or vice versa — and
-                # unlike the optimizer case, a converter exists
-                have, want_l = (("unrolled", "scanned")
-                                if self.config.scan_layers
-                                else ("scanned", "unrolled"))
-                raise ValueError(
-                    f"checkpoint at step {want or self.ckpt.latest_step()} "
-                    f"holds the {have} layer layout but this run "
-                    f"{'sets' if self.config.scan_layers else 'omits'} "
-                    f"--scan_layers ({want_l} layout); convert it with "
-                    f"`python tools/convert_checkpoint.py --src "
-                    f"{self.ckpt.directory} --dst <new_dir> --to {want_l}` "
-                    "or pass --no_resume / a fresh --output_dir"
-                )
-            state = self.init_state()
+        # hot tier (r18): the newest local snapshot's MANIFEST alone
+        # decides hot-vs-durable (a full array read + CRC on a multi-GB
+        # state would tax every restart's MTTR even when the durable
+        # tier wins); full validation runs in latest_valid() below once
+        # the hot tier is actually chosen. Considered only for
+        # auto-latest resumes (--global_step pins a durable step; hot
+        # generations are latest-only by design)
+        hot_meta = None
+        if (self.hot is not None and want is None and self.config.resume):
+            hot_meta = self.hot.latest_meta()
+        use_hot = (hot_meta is not None
+                   and (durable_latest is None
+                        or hot_meta.step >= durable_latest))
+        if not ((want is not None or self.config.resume)
+                and (durable_latest is not None or hot_meta is not None)):
+            return self.init_state(), 0
+        if use_hot:
+            saved = hot_meta.config or {}
+        else:
             try:
+                saved = self.ckpt.read_config(want) or {}
+            except Exception:  # noqa: BLE001 - an unreadable newest config
+                #               must not kill the resume: the restore
+                #               fallback below walks to a complete step
+                log.exception("checkpoint config unreadable; proceeding "
+                              "to the restore fallback")
+                saved = {}
+        saved_opt = saved.get("optimizer")
+        if saved_opt is not None and saved_opt != self.config.optimizer:
+            # fail with intent, not an opaque orbax pytree mismatch: the
+            # opt_state template cannot match a different optimizer, and
+            # no restacking bridges adam moments to momentum — genuinely
+            # lossy, so the named refusal stays (r18)
+            raise ValueError(
+                f"checkpoint at step "
+                f"{want or (hot_meta.step if use_hot else durable_latest)} "
+                f"was trained with --optimizer {saved_opt}, current run "
+                f"uses {self.config.optimizer}; pass --no_resume or a "
+                "fresh --output_dir to start over"
+            )
+        # layer-layout / mesh-shape changes are NO LONGER refusals: the
+        # converter logic runs inside restore (reshard-on-restore, r18).
+        # Checkpoints from before the scan_layers flag existed lack the
+        # key and are necessarily unrolled.
+        saved_scan = bool(saved.get("scan_layers", False))
+        layout_changed = saved_scan != bool(self.config.scan_layers)
+        mesh_changed = (saved.get("mesh") is not None
+                        and saved.get("mesh") != self.config.mesh)
+        if layout_changed or mesh_changed:
+            log.info(
+                "resuming across a config change "
+                "(mesh %s -> %s, scan_layers %s -> %s): "
+                "reshard-on-restore will convert in-restore",
+                saved.get("mesh"), self.config.mesh,
+                saved_scan, bool(self.config.scan_layers))
+        state = self.init_state()
+        if use_hot:
+            try:
+                # NOW pay the full read + CRC; an invalid newest
+                # generation falls back to an older one inside
+                # latest_valid(), which may land below the durable tier
+                hot_rec = self.hot.latest_valid()
+                if hot_rec is None:
+                    raise RuntimeError("no hot generation passed "
+                                       "validation")
+                if (durable_latest is not None
+                        and hot_rec.step < durable_latest):
+                    raise RuntimeError(
+                        f"newest VALID hot generation holds step "
+                        f"{hot_rec.step} < durable step {durable_latest}")
+                restored = self._restore_from_hot(hot_rec, state)
+                return restored, int(restored.step)
+            except Exception:  # noqa: BLE001 - the hot tier is an
+                #               optimisation: a snapshot that will not
+                #               restore degrades to the durable step
+                log.exception(
+                    "hot snapshot restore failed; falling back to the "
+                    "durable checkpoint tier")
+                if durable_latest is None:
+                    # hot-only run, every generation invalid: nothing
+                    # restorable exists. A raise here would crash-loop
+                    # under a relauncher; the pre-hot posture for
+                    # no-restorable-state is a fresh start, said loudly
+                    log.error(
+                        "no durable checkpoints and no hot generation "
+                        "restores under %s — starting FRESH from step 0 "
+                        "(the corrupt snapshots will be pruned by new "
+                        "saves; pass --global_step to refuse instead)",
+                        self.hot.base)
+                    return state, 0
+                hot_meta = None  # known-bad: no post-durable retry
+        try:
+            if layout_changed:
+                # a doomed template restore is skipped outright: the
+                # saved config already says the layouts differ
+                state, _ = self.ckpt.restore_resharded(want, state)
+            else:
                 state, _ = self.ckpt.restore(want, state)
-            except Exception as exc:
-                # an orbax tree/shape mismatch is opaque; name the likely
-                # cause (model geometry changed between save and resume)
-                raise ValueError(
-                    f"checkpoint at step {want or self.ckpt.latest_step()} "
-                    f"does not match the current model {self.config.model!r} "
-                    "(architecture changed since it was saved? note: ResNet "
-                    "checkpoints from before the stageN_blockM module "
-                    "renaming use BasicBlock_N/BottleneckBlock_N keys and "
-                    "cannot be restored); pass --no_resume or a fresh "
-                    "--output_dir to start over"
-                ) from exc
-            return state, int(state.step)
-        return self.init_state(), 0
+        except Exception as exc:
+            if not layout_changed:
+                # the direct restore failed with the SAME layout on
+                # record: a pipe-degree change (mesh-only) or a stale
+                # config still deserves the reshard attempt before the
+                # named refusal
+                try:
+                    state, _ = self.ckpt.restore_resharded(want, state)
+                    return state, int(state.step)
+                except Exception:  # noqa: BLE001 - refuse below with the
+                    pass           # original failure chained
+            # an orbax tree/shape mismatch is opaque; name the likely
+            # cause (model geometry changed between save and resume)
+            raise ValueError(
+                f"checkpoint at step {want or durable_latest} "
+                f"does not match the current model {self.config.model!r} "
+                "(architecture changed since it was saved? note: ResNet "
+                "checkpoints from before the stageN_blockM module "
+                "renaming use BasicBlock_N/BottleneckBlock_N keys and "
+                "cannot be restored); reshard-on-restore handles layout/"
+                "mesh changes, but not geometry changes — convert "
+                "offline with tools/convert_checkpoint.py if possible, "
+                "or pass --no_resume / a fresh --output_dir to start "
+                "over"
+            ) from exc
+        if hot_meta is not None and int(state.step) < hot_meta.step:
+            # the durable restore fell back past a torn newest step
+            # (crash mid-save) and delivered LESS than the hot tier
+            # holds — the one scenario the hot layer exists for;
+            # prefer the newer snapshot (validated now), keep the
+            # durable result if no generation survives validation
+            log.info(
+                "durable restore landed at step %d but a hot snapshot "
+                "holds step %d (newest durable step torn?); restoring "
+                "the hot snapshot instead",
+                int(state.step), hot_meta.step)
+            try:
+                hot_rec = self.hot.latest_valid()
+                if hot_rec is not None and hot_rec.step > int(state.step):
+                    restored = self._restore_from_hot(hot_rec, state)
+                    return restored, int(restored.step)
+                log.warning(
+                    "no hot generation newer than the durable step "
+                    "validated; keeping the durable step %d",
+                    int(state.step))
+            except Exception:  # noqa: BLE001 - optimisation tier only
+                log.exception(
+                    "hot snapshot restore failed; keeping the durable "
+                    "step %d", int(state.step))
+        return state, int(state.step)
+
+    def _restore_from_hot(self, hot_rec, template_state: TrainState
+                          ) -> TrainState:
+        """Restore from a validated hot snapshot through the SAME
+        reshard/placement path durable checkpoints use
+        (``checkpoint/reshard.place_state_onto_template`` — the
+        snapshot is a raw host tree by construction, so every hot
+        restore is a 'resharded' one, usually a no-op conversion +
+        placement)."""
+        from ..checkpoint.reshard import place_state_onto_template
+
+        state = place_state_onto_template(template_state, hot_rec.body,
+                                          hot_rec.residual,
+                                          desc="hot snapshot")
+        log.info("restored from hot snapshot",
+                 {"step": hot_rec.step,
+                  "generation": hot_rec.generation,
+                  "dir": str(hot_rec.path)})
+        return state
 
     # -- loops ------------------------------------------------------------
     def evaluate(self, state: TrainState) -> dict[str, float]:
@@ -685,6 +839,9 @@ class Trainer:
                     self.status.sources["fleet"] = self.fleet.state
                 if self.memory is not None:
                     self.status.sources["memory"] = self.memory.state
+                if self.supervisor is not None:
+                    self.status.sources["supervisor"] = \
+                        self.supervisor.state
                 self.status.start()
             except Exception:  # noqa: BLE001
                 log.exception("--status_port server failed to start; "
@@ -721,6 +878,17 @@ class Trainer:
             # telemetry first: flush every queued scalar (incl. the final
             # interval when the loop raised) before the writer closes
             self.telemetry.close()
+            # the drain may deliver a verdict after the loop's last poll
+            # (short runs): narrate a pending warn-mode decision so the
+            # dry-run log is complete — act mode past the loop stays a
+            # recorded decision, never a post-run action
+            if self.supervisor is not None and self.supervisor.mode == "warn":
+                try:
+                    dec = self.supervisor.poll()
+                    if dec is not None:
+                        self._act_on_supervisor(dec, None, dec["step"])
+                except Exception:  # noqa: BLE001 - narration only
+                    log.exception("supervisor post-run narration failed")
             self.metrics_writer.close()
             # the ledger's durable heartbeat: a crash/preemption still
             # leaves goodput.json current, so the NEXT attempt's downtime
@@ -898,8 +1066,11 @@ class Trainer:
                     state, metrics, fence = self._dispatch(state, batch, stop_signal)
                     # an interval that included eval/save/divergence work last
                     # iteration is not a step time — keep percentiles honest
-                    dt = timer.tick(discard=side_work)
+                    dt = timer.tick(discard=side_work
+                                    or self._hot_discard > 0)
                     side_work = False
+                    if self._hot_discard:
+                        self._hot_discard -= 1
                     # goodput: split this iteration's wall across buckets
                     # — measured parts (input stall, compile/save/eval
                     # durations recorded since the last tick) first,
@@ -916,6 +1087,7 @@ class Trainer:
                             dt, input_s=gp_wait - self._gp_wait_last,
                             compile_s=pend["compile"],
                             save_s=pend["checkpoint_save"],
+                            hot_save_s=pend["hot_checkpoint_save"],
                             eval_s=pend["eval"], other_s=pend["other"])
                     self._gp_wait_last = gp_wait
                     for k in pend:
@@ -1086,8 +1258,82 @@ class Trainer:
                         side_work = side_work or p50 is None or \
                             save_ms > max(0.25 * p50, 1.0)
 
+                    if (cfg.hot_save_steps and self.hot is not None
+                            and global_step % cfg.hot_save_steps == 0):
+                        # hot tier: a blocking device_get + local write,
+                        # booked to its OWN goodput bucket so the
+                        # MTTR-vs-overhead trade is measurable
+                        t_hot = time.perf_counter()
+                        hot_path = None
+                        with annotate("hot_checkpoint_save"):
+                            try:
+                                hot_path = self.hot.save(global_step,
+                                                         state, cfg)
+                            except Exception:  # noqa: BLE001 - the hot
+                                #               tier is an optimisation:
+                                #               a full/flaky local disk
+                                #               must not kill a run the
+                                #               durable tier still covers
+                                log.exception(
+                                    "hot snapshot save failed; disabling "
+                                    "the hot tier for this attempt (the "
+                                    "durable orbax saves continue)")
+                                self.hot.disabled = True
+                        if hot_path is not None:
+                            hot_s = time.perf_counter() - t_hot
+                            self._pending["hot_checkpoint_save"] += hot_s
+                            # discard this interval AND the next (only
+                            # when a snapshot actually happened — a
+                            # disabled tier returns None in microseconds
+                            # and must not starve the timer): the
+                            # blocking device_get drains the bounded
+                            # dispatch pipeline, and the disk write
+                            # keeps competing with compute (OS
+                            # writeback) for about one more interval —
+                            # neither is a steady-state step time.
+                            # Capped below the cadence so extreme
+                            # cadences (the deterministic-test setting
+                            # of 2) still record samples and the
+                            # timer-gated consumers (perf baseline,
+                            # restore-compare) keep working
+                            side_work = True
+                            self._hot_discard = min(
+                                2, cfg.hot_save_steps - 1)
+
+                    if self.fault is not None:
+                        # deterministic fault injection, AFTER the save
+                        # blocks: a crash at step N leaves step N's hot
+                        # snapshot durable — the scenario the elastic
+                        # stack exists to survive
+                        self.fault.maybe_fire(global_step, hot=self.hot)
+
+                    if self.supervisor is not None:
+                        dec = self.supervisor.poll()
+                        if dec is not None:
+                            if self._act_on_supervisor(dec, state,
+                                                       global_step):
+                                stop_now = True
+
                     if stop_now:
-                        if self._halt_vote and stop_signal["sig"] is None:
+                        # the drain thread may have delivered the sentry
+                        # trigger AFTER this iteration's poll but before
+                        # the supervisor's (same callback feeds both):
+                        # drain it now so the triage bundle for the very
+                        # verdict that stopped the run still lands
+                        if self.sentry is not None and self.sentry.triggered:
+                            trig = self.sentry.poll_trigger()
+                            if trig is not None:
+                                self._on_anomaly_trigger(state, trig,
+                                                         global_step, trace)
+                        if self._supervisor_stop:
+                            log.warning(
+                                "supervisor stop — checkpoint written, "
+                                "exiting for resume on the healthy "
+                                "subset (decision in supervisor.json; "
+                                "downtime books to evict_resume)",
+                                {"step": global_step},
+                            )
+                        elif self._halt_vote and stop_signal["sig"] is None:
                             # the sentry, not a scheduler, stopped this run
                             log.error(
                                 "anomaly halt — checkpointing and exiting "
@@ -1223,7 +1469,17 @@ class Trainer:
             self.telemetry.emit(global_step, {}, kind="mem")
         # perf-regression tripwire: one comparison per attempt, once
         # the steady-state timer has enough honest samples
-        self._maybe_check_baseline()
+        self._maybe_check_baseline(global_step)
+        # crash-survivable yardstick (r18): once the timer holds a
+        # handful of honest samples, persist this attempt's fingerprint
+        # at the perf cadence (rate-limited) — a hard-killed attempt
+        # must still leave the next attempt a baseline, or the elastic
+        # restart path flies blind (the restore-side COMPARE keeps its
+        # stricter 16-sample gate; the fingerprint records `steps`)
+        if self.step_timer.sample_count >= 8:
+            if now - getattr(self, "_last_baseline_write", 0.0) > 30.0:
+                self._last_baseline_write = now
+                self._write_perf_baseline()
         self._perf_marks = {
             "time": now, "step": global_step,
             "wait": stats["consumer_wait_s"],
@@ -1315,7 +1571,9 @@ class Trainer:
         """Fleet straggler verdict (drain thread): feed the sentry as a
         ``straggler`` trigger so the standard triage bundle lands with
         the offending host named — or, with no sentry configured, at
-        least say it loudly."""
+        least say it loudly. The supervisor (--supervise) receives the
+        same confirmed verdict: this is the sentry→supervisor path that
+        turns four rounds of detection into action."""
         reasons = [
             f"host {verdict['host']} step wall "
             f"{verdict['step_wall_ms']}ms > fleet median "
@@ -1329,6 +1587,74 @@ class Trainer:
             log.warning(
                 "fleet straggler detected (no --anomaly sentry active, "
                 "so no triage bundle): " + reasons[0], verdict)
+        if self.supervisor is not None:
+            self.supervisor.on_verdict("straggler", step, verdict)
+
+    def _act_on_supervisor(self, decision: dict, state,
+                           global_step: int) -> bool:
+        """Execute (act) or narrate (warn) a supervisor decision on the
+        loop thread. Returns True when THIS host should stop this
+        iteration (single-process act); multi-process runs stop through
+        the device-side vote agreement instead, so every host exits at
+        the identical lagged step — the r6 contract the eviction rides."""
+        action = decision.get("action")
+        host = decision.get("host")
+        narrative = (
+            f"checkpoint @ step {global_step} -> "
+            + (f"evict host {host} -> " if action == "evict" else "")
+            + "stop coherently -> resume on the "
+            + ("healthy subset" if action == "evict" else "next attempt")
+            + " (reshard-on-restore handles a smaller mesh)")
+        if self.supervisor.mode == "warn":
+            log.warning(
+                "supervisor (warn mode) would act on the %s verdict: %s "
+                "— logging only; pass --supervise act to execute",
+                decision.get("kind"), narrative)
+            return False
+        log.warning("supervisor acting on the %s verdict: %s",
+                    decision.get("kind"), narrative)
+        from ..utils.dist import process_count
+
+        if process_count() == 1:
+            # immediate save, single-controller only: on a multi-process
+            # fleet each host polls the verdict at its own iteration (or
+            # not at all if its exchange degraded that window), so the
+            # COLLECTIVE orbax save here could enter at different steps
+            # and wedge on the commit barrier — there, the loop-exit
+            # save at the vote-agreed stop step (identical on every
+            # host) is the coordinated checkpoint
+            t0 = time.perf_counter()
+            with annotate("checkpoint_save"):
+                if self.ckpt.latest_step() != global_step:
+                    self.ckpt.save(global_step, state, self.config,
+                                   force=True)
+            self._pending["checkpoint_save"] += time.perf_counter() - t0
+        if self.hot is not None:
+            t1 = time.perf_counter()
+            with annotate("hot_checkpoint_save"):
+                try:
+                    self.hot.save(global_step, state, self.config)
+                except Exception:  # noqa: BLE001 - a dying local disk
+                    #               (plausibly THE pathology on a sick
+                    #               host) must not abort the eviction:
+                    #               the durable save above already landed
+                    log.exception(
+                        "hot snapshot save failed during the supervisor "
+                        "stop; continuing the eviction on the durable "
+                        "checkpoint")
+                    self.hot.disabled = True
+            self._pending["hot_checkpoint_save"] += (time.perf_counter()
+                                                     - t1)
+        # the NEXT attempt books its restart gap to `evict_resume`,
+        # not generic preemption downtime: this stop was chosen
+        self.goodput.evicted = True
+        self.supervisor.mark_acted(decision)
+        # ride the same stop channel SIGTERM/anomaly-halt use: on
+        # multi-process runs the device-side OR reaches every host
+        # within K steps; single-process stops now
+        self._halt_vote = True
+        self._supervisor_stop = True
+        return not self._with_stop
 
     def _current_fingerprint(self) -> dict | None:
         """This attempt's steady-state perf fingerprint from the honest
@@ -1356,7 +1682,7 @@ class Trainer:
                             if self.memory is not None else None),
         )
 
-    def _maybe_check_baseline(self) -> None:
+    def _maybe_check_baseline(self, global_step: int = 0) -> None:
         """The restore-compare tripwire: ONCE per attempt, after the
         timer holds enough steady samples, compare against the prior
         attempt's ``perf_baseline.json`` and WARN per out-of-band
@@ -1370,16 +1696,26 @@ class Trainer:
             current = self._current_fingerprint()
             if current is None:
                 return
-            for w in self.baseline.compare(
-                    current, threshold_pct=self.config.regression_pct):
+            warns = self.baseline.compare(
+                current, threshold_pct=self.config.regression_pct)
+            for w in warns:
                 log.warning("perf regression vs prior attempt: " + w)
+            if warns and self.supervisor is not None:
+                # observe-only in the action table: recorded + surfaced,
+                # never a restart loop chasing a slower-but-correct run
+                self.supervisor.on_verdict(
+                    "regression", global_step, {"warnings": warns})
         except Exception:  # noqa: BLE001 - tripwire must not cost the run
             log.exception("perf baseline comparison failed")
 
     def _write_perf_baseline(self) -> None:
-        """Persist this attempt's fingerprint next to goodput.json
-        (clean shutdown path only: a crashed attempt's partial numbers
-        must not become the next attempt's yardstick)."""
+        """Persist this attempt's fingerprint next to goodput.json —
+        at clean shutdown AND (r18) at the perf cadence once the timer
+        holds >= 8 honest samples, so a hard-killed attempt still
+        leaves the next attempt a yardstick (side-work intervals are
+        already discarded; the restore-side COMPARE keeps its stricter
+        16-sample gate, and the fingerprint records `steps` so a reader
+        can weigh an early-write estimate accordingly)."""
         try:
             current = self._current_fingerprint()
             if current is not None:
@@ -1631,6 +1967,8 @@ class Trainer:
             log.warning(
                 "memory pressure detected (no --anomaly sentry active, "
                 "so no triage bundle): " + reasons[0], verdict)
+        if self.supervisor is not None:
+            self.supervisor.on_verdict("mem_pressure", step, verdict)
 
     def _emit_hlo_report(self, hlo_text: str, compile_s: float):
         """Write the schedule report + tripwire warnings

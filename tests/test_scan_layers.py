@@ -275,11 +275,14 @@ def test_convert_state_tree_roundtrip():
     assert out["w"].names == ("layers", "mlp", None)
 
 
-def test_mismatched_layout_restore_fails_with_intent(tmp_path):
-    """The engine refuses a layout-mismatched restore from the saved
-    config alone — before building any template state — naming the
-    converter command. A trivial payload suffices: the check never reads
-    the state."""
+def test_lossy_mismatch_restore_still_fails_with_intent(tmp_path):
+    """r18 transition pin, refusal half: reshard-on-restore lifted the
+    layout-mismatch refusal (the success half rides
+    test_checkpoint_conversion_roundtrip_and_mismatch and
+    tests/test_elastic.py), but a GENUINELY lossy mismatch — here a
+    checkpoint missing the whole param/optimizer state, standing in for
+    a changed model geometry — must still refuse with intent, naming
+    the offline converter and --no_resume."""
     from pytorch_ddp_template_tpu.checkpoint.manager import CheckpointManager
 
     cfg = TrainingConfig(model="gpt-tiny", dataset_size=32,
@@ -330,12 +333,17 @@ def test_checkpoint_conversion_roundtrip_and_mismatch(tmp_path):
     mngr.close()
     saved_params = jax.device_get(params)
 
-    # restoring the unrolled checkpoint under --scan_layers without
-    # conversion must fail with intent, naming the converter
+    # r18 transition pin, success half: restoring the unrolled
+    # checkpoint under --scan_layers — the exact config the pre-r18
+    # engine refused with "convert it with tools/convert_checkpoint.py"
+    # — now reshards in-restore, bit-exact with the offline converter
+    # run below (same restacking core, run in-process)
     mis_trainer, _ = _tiny_trainer(tmp_path, "unrolled", scan_layers=True)
-    with pytest.raises(ValueError, match="convert_checkpoint"):
-        mis_trainer.restore_or_init()
+    mis_state, mis_start = mis_trainer.restore_or_init()
     mis_trainer.ckpt.close()
+    assert mis_start == 2
+    assert _max_abs_diff(restack_layer_trees(saved_params),
+                         jax.device_get(mis_state.params)) == 0.0
 
     # convert -> a --scan_layers run restores the restacked weights (and
     # momentum mirrors) through the full Trainer template path

@@ -12,6 +12,11 @@
 #                                                # bench.py output) vs
 #                                                # the committed ones
 #   TOLERANCE=0.15 bash tools/ci_bench_check.sh /tmp/fresh
+#   RUN_ELASTIC=1 bash tools/ci_bench_check.sh  # r18: run BENCH_MODE=elastic
+#                                               # fresh (CPU, crash->resume
+#                                               # MTTR + fallback legs) and
+#                                               # gate it vs the committed
+#                                               # elastic record
 #
 # Exit codes are bench_diff's: 0 in-band, 1 drift, 2 no overlap/usage
 # (an empty comparison must not read as green). Output is the github
@@ -21,6 +26,17 @@ cd "$(dirname "$0")/.."
 R=bench_records
 CANDIDATE=${1:-$R}
 TOLERANCE=${TOLERANCE:-0.25}
+
+if [ "${RUN_ELASTIC:-0}" = "1" ]; then
+  # the elastic legs run the full crash->resume episodes, so give them
+  # their own timeout and a scratch record to gate
+  FRESH=$(mktemp -d)/elastic_fresh.jsonl
+  BENCH_CPU=${BENCH_CPU:-1} BENCH_CPU_DEVICES=${BENCH_CPU_DEVICES:-8} \
+    BENCH_MODE=elastic BENCH_STEPS=${BENCH_STEPS:-20} \
+    BENCH_WARMUP=${BENCH_WARMUP:-3} \
+    timeout 1800 python bench.py | tee "$FRESH"
+  CANDIDATE=$FRESH
+fi
 
 python tools/bench_diff.py "$R" "$CANDIDATE" \
   --tolerance "$TOLERANCE" --format github

@@ -49,51 +49,18 @@ def convert_state(state, to: str, pipe_stages: int | None = None):
     checkpoint convert its blocks to the r7 layer layouts (the
     interchange forms) — all conversions are lossless reshapes,
     round-tripping bit-exact (tests/test_pipeline.py).
+
+    Since r18 the converter core lives in
+    ``parallel/stacking.convert_tree_layout`` so the SAME logic runs
+    inside ``CheckpointManager``'s reshard-on-restore path; this CLI
+    keeps the strict contract (a no-op conversion is refused).
     """
     from pytorch_ddp_template_tpu.parallel.stacking import (
-        detect_layer_layout, detect_pipe_stages, layer_stack_to_pipe,
-        pipe_to_layer_stack, repipe_stage_trees, restack_layer_trees,
-        unroll_layer_trees,
+        convert_tree_layout,
     )
 
-    pipe_p = detect_pipe_stages(state)
-    have = "pipelined" if pipe_p else detect_layer_layout(state)
-    if to == "pipelined":
-        if pipe_stages is None or pipe_stages < 2:
-            raise ValueError(
-                "--to pipelined needs --pipe_stages N (N >= 2): the "
-                "stage count of the target pipe mesh axis")
-        if have == "pipelined":
-            if pipe_stages == pipe_p:
-                raise ValueError(
-                    f"checkpoint is already stacked for {pipe_p} "
-                    "pipeline stages; converting would be a no-op")
-            return repipe_stage_trees(state, pipe_stages)
-        if have == "none":
-            raise ValueError(
-                "checkpoint holds no 'blocks' layer stack to split into "
-                "pipeline stages — pipelined layouts serve the gpt-pipe "
-                "entries only"
-            )
-        if have == "unrolled":
-            state = restack_layer_trees(state)
-        return layer_stack_to_pipe(state, pipe_stages)
-    if have == "pipelined":
-        state = pipe_to_layer_stack(state)  # now the scanned spelling
-        return state if to == "scanned" else unroll_layer_trees(state)
-    if have == "none":
-        raise ValueError(
-            "checkpoint holds no transformer layer stack (neither layer_{i} "
-            "subtrees nor a stacked 'layers' subtree) — nothing to convert; "
-            "--scan_layers applies to the transformer families only"
-        )
-    if have == to:
-        raise ValueError(
-            f"checkpoint is already in the {to} layout; converting would be "
-            "a no-op — point --src at the other layout or skip the step"
-        )
-    return (restack_layer_trees(state) if to == "scanned"
-            else unroll_layer_trees(state))
+    return convert_tree_layout(state, to, pipe_stages=pipe_stages,
+                               strict=True)
 
 
 def convert_checkpoint(src: str, dst: str, to: str,

@@ -19,10 +19,10 @@
 # bench mode appends one JSON line to its round's records file.
 # Usage: bash tools/tpu_followup.sh <round>   (requires the axon tunnel)
 set -u
-ROUND=${1:?usage: tpu_followup.sh <round: 4..17>}
+ROUND=${1:?usage: tpu_followup.sh <round: 4..18>}
 case "$ROUND" in (*[!0-9]*|'') echo "round must be a number, got '$ROUND'" >&2; exit 2;; esac
-if [ "$ROUND" -lt 4 ] || [ "$ROUND" -gt 17 ]; then
-  echo "unknown round $ROUND (expected 4..17)" >&2; exit 2
+if [ "$ROUND" -lt 4 ] || [ "$ROUND" -gt 18 ]; then
+  echo "unknown round $ROUND (expected 4..18)" >&2; exit 2
 fi
 cd "$(dirname "$0")/.."
 R=bench_records
@@ -289,6 +289,42 @@ legs_r17() {
     2>>"$ERR" || RC=1
   cp /tmp/quant_tpu_r17/hlo_report.json "$R/quant_hlo_report_tpu_r17.json" \
     2>/dev/null && echo "quant hlo_report (tripwire clean?) copied" >&2
+}
+
+legs_r18() {
+  # elastic fleet: the BENCH_MODE=elastic legs on real hardware (hot-save
+  # step-time overhead pair, the crash->resume MTTR/lost-steps episodes
+  # with REAL restore costs — orbax-from-durable vs local-npz hot — and
+  # the corrupt-snapshot / torn-durable-step fallbacks), then the real
+  # preemption drill a CPU host cannot stage: SIGTERM a hot-snapshotting
+  # run mid-flight (graceful checkpoint + clean exit, the r6 stop
+  # agreement) and resume on HALF the chips — reshard-on-restore places
+  # the surviving shape directly; describe/goodput land in $R as proof.
+  run elastic_legs elastic_tpu_r18.jsonl 2400 BENCH_MODE=elastic BENCH_STEPS=20 BENCH_WARMUP=3
+  local n half
+  n=$(python -c "import jax; print(len(jax.devices()))" 2>>"$ERR") || n=1
+  half=$(( n > 1 ? n / 2 : 1 ))
+  rm -rf /tmp/elastic_tpu_r18
+  timeout 1200 python ddp.py --model gpt-small --scan_layers \
+    --mesh "data:$n" --hot_save_steps 5 --save_steps 50 --max_steps 400 \
+    --per_device_train_batch_size 4 --logging_steps 5 \
+    --dataset_size 4096 --output_dir /tmp/elastic_tpu_r18 2>>"$ERR" &
+  local train_pid=$!
+  sleep 90
+  kill -TERM "$train_pid" 2>/dev/null  # the preemption: checkpoint + exit
+  wait "$train_pid"
+  timeout 1200 python ddp.py --model gpt-small --scan_layers \
+    --mesh "data:$half" --hot_save_steps 5 --save_steps 50 \
+    --max_steps 400 --per_device_train_batch_size 4 --logging_steps 5 \
+    --dataset_size 4096 --output_dir /tmp/elastic_tpu_r18 \
+    2>&1 | grep -a "restored from hot snapshot\|reshard-on-restore\|goodput summary\|perf regression" >> "$ERR" || RC=1
+  cp /tmp/elastic_tpu_r18/describe.json "$R/elastic_describe_tpu_r18.json" \
+    2>/dev/null && echo "describe.json (resumed on data:$half) copied" >&2
+  cp /tmp/elastic_tpu_r18/goodput.json "$R/elastic_goodput_tpu_r18.json" \
+    2>/dev/null && echo "goodput.json (attempt 2 accounting) copied" >&2
+  python tools/bench_diff.py "$R" "$R/elastic_tpu_r18.jsonl" --format github \
+    > "$R/bench_diff_tpu_r18.md" 2>>"$ERR" \
+    || echo "bench_diff flagged drift (see bench_diff_tpu_r18.md)" >&2
 }
 
 # -- the historical chain ---------------------------------------------------
