@@ -190,6 +190,16 @@ class TrainingConfig:
     #                         warn logs the would-be action only; every
     #                         decision lands in supervisor.json and the
     #                         goodput `evict_resume` bucket
+    supervise_cooldown_s: float = 600.0  # hysteresis: a stopping verdict
+    #                         within this window of the previous ACTED
+    #                         stop is downgraded to observe-only (a
+    #                         flapping host cannot evict-loop the
+    #                         fleet); enforced across attempts from the
+    #                         supervisor.json ledger. 0 = off
+    supervise_evict_budget: int = 4  # max acted evictions per trailing
+    #                         24h (the "K evictions per day" budget,
+    #                         same ledger); past it, evict verdicts are
+    #                         recorded suppressed. 0 = unlimited
     inject_fault: str = ""  # deterministic fault injection
     #                         "kind:step[:param]" with kind one of
     #                         crash | hang-host | corrupt-hot-snapshot |
@@ -463,6 +473,14 @@ class TrainingConfig:
             raise ValueError(
                 f"unknown --supervise {self.supervise!r}; expected "
                 "off | warn | act")
+        if self.supervise_cooldown_s < 0:
+            raise ValueError(
+                f"--supervise_cooldown_s must be >= 0, got "
+                f"{self.supervise_cooldown_s} (0 = off)")
+        if self.supervise_evict_budget < 0:
+            raise ValueError(
+                f"--supervise_evict_budget must be >= 0, got "
+                f"{self.supervise_evict_budget} (0 = unlimited)")
         if self.inject_fault:
             # fail a typo'd fault spec at parse time, not at the
             # injection step hours into the run it was meant to test
@@ -817,6 +835,18 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "'warn' logs the would-be action only. Every "
                         "decision lands in supervisor.json, /status "
                         "and the goodput evict_resume bucket.")
+    p.add_argument("--supervise_cooldown_s", type=float, default=600.0,
+                   help="Supervisor hysteresis: a stopping verdict "
+                        "landing within this window of the previous "
+                        "acted stop is recorded but downgraded to "
+                        "observe-only, so a flapping host cannot "
+                        "evict-loop the fleet; enforced across "
+                        "attempts from the supervisor.json ledger. "
+                        "0 = off.")
+    p.add_argument("--supervise_evict_budget", type=int, default=4,
+                   help="Max acted evictions per trailing 24h (same "
+                        "ledger); evict verdicts past the budget are "
+                        "recorded suppressed. 0 = unlimited.")
     p.add_argument("--inject_fault", type=str, default="",
                    help="Deterministic fault injection 'kind:step"
                         "[:param]', kind one of crash | hang-host | "

@@ -51,10 +51,15 @@ log = get_logger(__name__)
 #: previously indistinguishable inside ``checkpoint_save``) and
 #: ``evict_resume`` (downtime the SUPERVISOR chose — checkpoint → evict
 #: → resume — previously booked as generic ``halted`` preemption), so
-#: the supervisor's cost/benefit is readable straight off goodput.json
+#: the supervisor's cost/benefit is readable straight off goodput.json.
+#: r19 adds the serving buckets: ``serve_prefill`` (admission forwards —
+#: the TTFT cost) and ``serve_decode`` (per-token steps) — an engine
+#: hosting a serving loop meters it with the same ledger the train loop
+#: uses, so train-vs-serve wall split reads straight off goodput.json
 BUCKETS = ("productive_step", "compile", "checkpoint_save",
            "hot_checkpoint_save", "restore", "input_stall", "eval",
-           "halted", "evict_resume", "other")
+           "halted", "evict_resume", "serve_prefill", "serve_decode",
+           "other")
 
 FILENAME = "goodput.json"
 

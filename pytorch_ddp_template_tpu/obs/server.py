@@ -119,7 +119,7 @@ def prometheus_lines(snapshot: dict[str, Any]) -> str:
     if age is not None:
         _gauge(lines, seen, prom_name("last_update_age_seconds"), age,
                {"host": host})
-    for kind in ("progress", "perf", "mem"):
+    for kind in ("progress", "perf", "mem", "serve"):
         rec = snapshot.get("records", {}).get(kind) or {}
         for k, v in rec.items():
             if isinstance(v, (list, tuple)) or k.endswith("_repr"):

@@ -60,14 +60,32 @@ def _block_logits(hidden, table, bias, step, *, block: int, vocab: int,
     return jnp.where(v_ids < vocab, logits, NEG_INF), tb
 
 
+def _argmax_step(best_v, best_i, logits, v0):
+    """One running-argmax update for a logits block whose absolute vocab
+    ids are ``[v0, v0 + logits.shape[-1])`` — the greedy-decode step of
+    the online bundle, standalone so the serving engine can drive it
+    without the loss machinery (:func:`greedy_decode`).
+
+    Ties break toward the LOWEST absolute id regardless of block visit
+    order (the visit-order invariant): the single-table scan, the TP
+    ring head (shards visited in ring order) and the serving decode all
+    pick identical predictions. Pinned by direct unit test.
+    """
+    bi = jnp.argmax(logits, axis=-1)
+    bv = jnp.take_along_axis(logits, bi[..., None], axis=-1)[..., 0]
+    cand = v0 + bi
+    take = (bv > best_v) | ((bv == best_v) & (cand < best_i))
+    return jnp.where(take, bv, best_v), jnp.where(take, cand, best_i)
+
+
 def _online_step(carry, logits, v0, targets, block: int):
     """One online-logsumexp/label/argmax update for a logits block whose
     absolute vocab ids are ``[v0, v0 + block)``.
 
     Shared between the single-table scan (``v0 = step * block``) and the
     TP ring head (``v0 = shard_offset + step * block``, ops visited in
-    ring order). Argmax ties break toward the LOWEST absolute id
-    regardless of visit order, so both paths pick identical predictions.
+    ring order). The argmax leg is :func:`_argmax_step` (extracted —
+    the serving engine's greedy decode drives it directly).
     """
     m, l, label, best_v, best_i = carry
     # online logsumexp
@@ -80,13 +98,7 @@ def _online_step(carry, logits, v0, targets, block: int):
     idx = jnp.clip(targets - v0, 0, block - 1)
     val = jnp.take_along_axis(logits, idx[..., None], axis=-1)[..., 0]
     label = jnp.where(in_blk, val, label)
-    # running argmax; lowest-id wins ties (visit-order invariant)
-    bi = jnp.argmax(logits, axis=-1)
-    bv = jnp.take_along_axis(logits, bi[..., None], axis=-1)[..., 0]
-    cand = v0 + bi
-    take = (bv > best_v) | ((bv == best_v) & (cand < best_i))
-    best_v = jnp.where(take, bv, best_v)
-    best_i = jnp.where(take, cand, best_i)
+    best_v, best_i = _argmax_step(best_v, best_i, logits, v0)
     return m_new, l, label, best_v, best_i
 
 
@@ -191,6 +203,51 @@ def lm_head_loss(hidden, table, targets, *, bias=None, block: int = 8192):
         bias = jnp.pad(bias, (0, pad))
     return blockwise_lm_head(hidden, table, bias,
                              targets.astype(jnp.int32), block, vocab)
+
+
+def greedy_decode(hidden, table, *, bias=None, block: int = 8192):
+    """Blockwise greedy decode: ``argmax_v(hidden @ table.T + bias)``
+    without ever materialising the ``(..., V)`` logits.
+
+    The greedy-decode step of the online-argmax bundle, standalone
+    (r19): the serving engine's per-token sampler. Until now the
+    running argmax was only exercised through :func:`lm_head_loss` /
+    :func:`tp_lm_head_loss` as the accuracy metric; here it IS the
+    output. Peak memory is ``O(batch * block)`` — at serving batch
+    sizes the logits row never exists, which is what lets the decode
+    step share HBM with the paged KV cache.
+
+    Args:
+      hidden: ``(..., E)`` final hidden states (any float dtype; the
+        per-block logits accumulate in f32 on the MXU).
+      table: ``(V, E)`` tied embedding/output table.
+      bias: optional ``(V,)`` output bias.
+      block: vocab tile width.
+
+    Returns ``(...,)`` int32 argmax token ids. Ties break toward the
+    lowest vocab id regardless of block visit order (the
+    :func:`_argmax_step` invariant — pinned by unit test).
+    """
+    vocab, _ = table.shape
+    block = min(block, vocab)
+    n = _num_blocks(vocab, block)
+    pad = n * block - vocab
+    if bias is None:
+        bias = jnp.zeros((vocab,), jnp.float32)
+    if pad:
+        table = jnp.pad(table, ((0, pad), (0, 0)))
+        bias = jnp.pad(bias, (0, pad))
+    shape = hidden.shape[:-1]
+
+    def body(carry, step):
+        logits, _ = _block_logits(hidden, table, bias, step,
+                                  block=block, vocab=vocab)
+        return _argmax_step(*carry, logits, step * block), None
+
+    init = (jnp.full(shape, NEG_INF, jnp.float32),
+            jnp.zeros(shape, jnp.int32))
+    (_, best_i), _ = lax.scan(body, init, jnp.arange(n))
+    return best_i
 
 
 # -- TP ring head (--tp_overlap): model-sharded vocab, rotating stats ------

@@ -1,0 +1,16 @@
+"""Serving engine (r19): prefill + per-token decode over a paged KV
+cache, with continuous batching — the inference story for the
+ROADMAP's "millions of users".
+
+The expensive training primitives were idle outside the train loop;
+here they serve: ``ops/flash.py``/``ops/attention.py`` run the bucketed
+prefill, ``ops/lm_head.greedy_decode`` (the online-argmax bundle,
+extracted) samples without materialising logits, and
+``CheckpointManager.restore_raw`` + the r18 reshard converter load a
+training checkpoint at ANY layer layout straight into the serving
+template. See ``serve/engine.py`` for the architecture note.
+"""
+
+from .engine import ServeConfig, ServeEngine  # noqa: F401
+from .kv_cache import PagedKVCache  # noqa: F401
+from .scheduler import ContinuousScheduler, Request  # noqa: F401

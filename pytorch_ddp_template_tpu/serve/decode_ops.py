@@ -1,0 +1,222 @@
+"""Decode-specialized attention: one query token per sequence reading
+scattered KV blocks through a block table.
+
+The training flash kernel (``ops/flash.py``) is the wrong shape for
+decode: its grid tiles a (seq x seq) logit square, but a decode step
+has ONE query row per sequence attending over a context that lives in
+non-contiguous physical blocks (``serve/kv_cache.py``). This module is
+the gather-KV path:
+
+- :func:`paged_attention` — the public op. ``q (S, H, D)`` against the
+  pooled ``(N, B, H, D)`` K/V of one layer, routed per ``PAGED_IMPL``.
+- ``xla`` (default) — gather-by-table (``k_pool[tables]``), mask
+  positions ``>= context_len``, f32 softmax. XLA lowers the gather to a
+  dynamic-slice loop; at serving batch sizes the whole gathered context
+  is tiny next to the weights, and this formulation is exactly
+  re-orderable against the dense reference (the parity test's anchor).
+- ``pallas`` — the real gather kernel: grid ``(S, max_blocks)`` with
+  the block table and context lengths as **scalar-prefetch** operands,
+  so each kv BlockSpec's ``index_map`` reads the table and DMAs the
+  right physical block — the kernel never touches a gathered copy.
+  Online-softmax state (m/l lane-replicated, acc) lives in VMEM scratch
+  across the sequential block dimension, the ``ops/flash.py``
+  recurrence re-shaped for a single query row per sequence.
+
+The Pallas path follows the FLASH_BWD/QUANT_IMPL convention: validated
+in interpret mode on CPU (the parity test), default ``xla`` everywhere
+until a real-Mosaic parity record lands (``tools/tpu_followup.sh
+legs_r19``). ``PAGED_IMPL=pallas`` opts in; int8 KV (quantized pool)
+is served by the xla path only — the kernel takes the f32 pool.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..utils import get_logger
+
+log = get_logger(__name__)
+
+#: renamed TPUCompilerParams → CompilerParams across jax versions
+CompilerParams = (getattr(pltpu, "CompilerParams", None)
+                  or pltpu.TPUCompilerParams)
+
+NEG_INF = -1e30
+LANES = 128
+
+_impl_logged: set[str] = set()
+
+
+def paged_impl() -> str:
+    """Active lowering for the paged decode attention, read at TRACE
+    time (the FLASH_BWD/QUANT_IMPL convention): ``PAGED_IMPL=pallas``
+    opts into the gather kernel (interpret mode off-TPU — how CPU CI
+    validates it); default ``xla`` until the real-Mosaic parity record
+    (legs_r19). A typo'd override fails loudly."""
+    impl = os.environ.get("PAGED_IMPL", "xla")
+    if impl not in ("xla", "pallas"):
+        raise ValueError(f"PAGED_IMPL={impl!r}: expected 'xla' or 'pallas'")
+    if impl not in _impl_logged:
+        _impl_logged.add(impl)
+        log.info(
+            "paged decode attention lowering selected (trace-time; set "
+            "PAGED_IMPL before first use or jax.clear_caches() to change)",
+            {"impl": impl})
+    return impl
+
+
+def gather_kv(pool_leaf: jax.Array, tables: jax.Array) -> jax.Array:
+    """``(N, B, H, ...)[tables (S, M)]`` -> ``(S, M*B, H, ...)``: one
+    sequence's logical context, materialised in table order."""
+    g = pool_leaf[tables]  # (S, M, B, H, ...)
+    s, m, b = g.shape[:3]
+    return g.reshape(s, m * b, *g.shape[3:])
+
+
+def _paged_attention_xla(q, k_pool, v_pool, tables, context_lens,
+                         k_scale=None, v_scale=None):
+    dtype = q.dtype
+    d = q.shape[-1]
+    k = gather_kv(k_pool, tables)          # (S, T, H, D)
+    v = gather_kv(v_pool, tables)
+    if k_scale is not None:
+        from .kv_cache import dequantize_kv
+
+        k = dequantize_kv(k, gather_kv(k_scale, tables))
+        v = dequantize_kv(v, gather_kv(v_scale, tables))
+    qf = q.astype(jnp.float32) * (d ** -0.5)
+    logits = jnp.einsum("shd,sthd->sht", qf, k.astype(jnp.float32),
+                        preferred_element_type=jnp.float32)
+    t = logits.shape[-1]
+    valid = lax.broadcasted_iota(jnp.int32, (1, 1, t), 2) \
+        < context_lens[:, None, None]
+    logits = jnp.where(valid, logits, NEG_INF)
+    # fully-masked rows (inactive slots, context_len 0) must yield 0,
+    # not NaN — the engine discards them but the program must stay finite
+    weights = jax.nn.softmax(logits, axis=-1)
+    weights = jnp.where(valid.any(-1, keepdims=True), weights, 0.0)
+    out = jnp.einsum("sht,sthd->shd", weights, v.astype(jnp.float32))
+    return out.astype(dtype)
+
+
+def _paged_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_ref, l_ref, acc_ref, *, block_size: int,
+                  max_blocks: int, scale: float):
+    s = pl.program_id(0)   # sequence slot
+    j = pl.program_id(1)   # logical block (sequential)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    ctx = lens_ref[s]
+    # a block whose first slot is past the context holds nothing valid;
+    # skip its compute entirely (the tail of a short sequence)
+    @pl.when(j * block_size < ctx)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale        # (H, D)
+        k = k_ref[0].astype(jnp.float32)                # (B, H, D)
+        v = v_ref[0].astype(jnp.float32)
+        # (H, B): contract D, batch H
+        logits = lax.dot_general(
+            q, k, (((1,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        pos = j * block_size + lax.broadcasted_iota(
+            jnp.int32, logits.shape, 1)
+        logits = jnp.where(pos < ctx, logits, NEG_INF)
+        m_prev = m_ref[...]                              # (H, LANES)
+        m_new = jnp.maximum(m_prev, jnp.max(logits, axis=1, keepdims=True))
+        p = jnp.exp(logits - m_new[:, :1])               # (H, B)
+        correction = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_ref[...] * correction + jnp.sum(p, axis=1,
+                                                       keepdims=True)
+        m_ref[...] = m_new
+        # (H, D): p (H, B) x v (B, H, D), batch H
+        pv = lax.dot_general(p, v, (((1,), (0,)), ((0,), (1,))),
+                             preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * correction[:, :1] + pv
+
+    @pl.when(j == max_blocks - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        out = acc_ref[...] / l
+        # fully-masked slot (ctx 0): emit zeros, not NaN
+        out = jnp.where(m_ref[:, :1] <= NEG_INF / 2, 0.0, out)
+        o_ref[0] = out.astype(o_ref.dtype)
+
+
+def _paged_attention_pallas(q, k_pool, v_pool, tables, context_lens):
+    s, h, d = q.shape
+    _, block_size = k_pool.shape[0], k_pool.shape[1]
+    max_blocks = tables.shape[1]
+    interpret = jax.default_backend() != "tpu"
+    kernel = functools.partial(
+        _paged_kernel, block_size=block_size, max_blocks=max_blocks,
+        scale=d ** -0.5)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # tables, context_lens
+        grid=(s, max_blocks),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda i, j, tb, ln: (i, 0, 0)),
+            # the gather: the kv BlockSpec reads the PHYSICAL block id
+            # from the prefetched table — the DMA itself is the page walk
+            pl.BlockSpec((1, block_size, h, d),
+                         lambda i, j, tb, ln: (tb[i, j], 0, 0, 0)),
+            pl.BlockSpec((1, block_size, h, d),
+                         lambda i, j, tb, ln: (tb[i, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda i, j, tb, ln: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, LANES), jnp.float32),   # m (lane-replicated)
+            pltpu.VMEM((h, LANES), jnp.float32),   # l
+            pltpu.VMEM((h, d), jnp.float32),       # acc
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s, h, d), q.dtype),
+        compiler_params=CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(tables.astype(jnp.int32), context_lens.astype(jnp.int32),
+      q, k_pool, v_pool)
+
+
+def paged_attention(q, k_pool, v_pool, tables, context_lens, *,
+                    k_scale=None, v_scale=None):
+    """Single-token attention over a paged KV pool.
+
+    Args:
+      q: ``(S, H, D)`` — one query token per decode slot.
+      k_pool, v_pool: ``(N, B, H, D)`` — ONE layer's physical blocks
+        (``PagedKVCache.pool`` leaf, layer axis already sliced).
+      tables: ``(S, max_blocks)`` int32 physical-block ids, padded with
+        the null block.
+      context_lens: ``(S,)`` int32 valid context per slot (0 = inactive
+        slot; its output row is zeros).
+      k_scale, v_scale: int8-pool dequant scales ``(N, B, H, 1)``
+        (``kv_quant="int8"``; xla path only).
+
+    Returns ``(S, H, D)`` in ``q.dtype``.
+    """
+    impl = paged_impl()
+    if impl == "pallas":
+        if k_scale is not None:
+            raise ValueError(
+                "PAGED_IMPL=pallas does not serve the int8 KV pool yet "
+                "(the gather kernel takes the f32 pool); drop one of "
+                "--kv_quant int8 / PAGED_IMPL=pallas")
+        return _paged_attention_pallas(q, k_pool, v_pool, tables,
+                                       context_lens)
+    return _paged_attention_xla(q, k_pool, v_pool, tables, context_lens,
+                                k_scale=k_scale, v_scale=v_scale)

@@ -1,0 +1,493 @@
+"""The serving engine: bucketed prefill + ONE compiled decode program
+over a model-sharded paged KV cache, with continuous batching.
+
+Compile-count contract (the recompile-stall killer):
+
+- **decode**: every step runs the SAME jitted program — fixed
+  ``(max_slots,)`` token/position/length lanes, a fixed
+  ``(max_slots, max_blocks)`` block table, the fixed-shape KV pool.
+  Sequences of any length mix freely; growth across a block boundary
+  is a free-list pop in the allocator, never a new shape. Pinned by
+  test AND by the ``BENCH_MODE=serve`` committed record
+  (``serve_decode_zero_recompile``).
+- **prefill**: one compiled program per *bucketed* prompt length
+  (prompts pad up to the bucket; the padded tail is written into the
+  null block's scrap space and masked by the real context length), so
+  the compile count is ``len(buckets)``, not ``len(distinct prompts)``.
+
+Per engine step (:meth:`ServeEngine.step`): evictions happened at the
+previous step's boundary, so first ADMIT (scheduler FCFS over free
+slots + the committed-blocks budget), prefilling each admission and
+emitting its first token (greedy, via the extracted
+``ops/lm_head.greedy_decode`` — the ``(B, V)`` logits row never
+exists); then ONE decode dispatch for every running slot; then book
+finished sequences out. Prefill/decode wall-clock books to the goodput
+ledger's ``serve_prefill``/``serve_decode`` buckets, and the flat
+stats record feeds ``/status`` (kind ``serve``) and the
+``tpuddp_serve_*`` gauges on ``/metrics``.
+
+Params load through ``CheckpointManager.restore_raw`` + the r18
+layout converter (:meth:`ServeEngine.from_checkpoint`): a training
+checkpoint at ANY layer layout (scanned / unrolled / pipelined)
+restores into the serving template directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import get_logger
+from .kv_cache import NULL_BLOCK, PagedKVCache
+from .model import decode_forward, prefill_forward, stacked_layers
+from .scheduler import ContinuousScheduler, Request
+
+log = get_logger(__name__)
+
+
+def _default_buckets(block_size: int, max_model_len: int) -> tuple[int, ...]:
+    """Power-of-two prompt buckets, block-aligned, up to the model
+    limit — one compiled prefill program each."""
+    buckets = []
+    b = max(block_size, 16)
+    while b < max_model_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_model_len)
+    return tuple(sorted(set(buckets)))
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Engine geometry. Every field is a compile-shape or capacity
+    knob; none of them changes with the traffic."""
+
+    block_size: int = 16          # tokens per KV block
+    num_blocks: int = 64          # physical pool size (incl. null block)
+    max_slots: int = 4            # decode lanes (the decode batch shape)
+    max_model_len: int = 128      # hard per-sequence length limit
+    prefill_buckets: tuple[int, ...] | None = None  # None = powers of two
+    kv_quant: str = "off"         # off | int8 (r17 primitives)
+    eos_id: int | None = None     # early-stop token (None = length-only)
+    vocab_block: int = 8192       # greedy-decode vocab tile
+    static_batch: bool = False    # ablation: wave admission (the baseline)
+
+    def buckets(self) -> tuple[int, ...]:
+        bks = self.prefill_buckets or _default_buckets(
+            self.block_size, self.max_model_len)
+        for b in bks:
+            if b % self.block_size:
+                raise ValueError(
+                    f"prefill bucket {b} not a multiple of block_size "
+                    f"{self.block_size} (bucket blocks insert whole)")
+            if b > self.max_model_len:
+                raise ValueError(
+                    f"prefill bucket {b} exceeds max_model_len "
+                    f"{self.max_model_len}")
+        return tuple(sorted(bks))
+
+
+def place_for_serving(params: dict, mesh) -> dict:
+    """Model-shard the serving template over the mesh's ``model`` axis:
+    attention heads (qkv kernel dim 2 / out kernel dim 1, with the
+    leading stacked-layer axis) and the MLP hidden split; embeddings,
+    norms and biases that span ``embed`` replicate. GSPMD partitions
+    the jitted prefill/decode like any other program from these
+    placements."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from ..runtime.context import MODEL_AXIS
+
+    n = mesh.shape.get(MODEL_AXIS, 1)
+
+    def spec(path) -> P:
+        keys = [getattr(k, "key", getattr(k, "name", str(k))) for k in path]
+        if n > 1 and "layers" in keys:
+            name, field = keys[-2], keys[-1]
+            if name in ("query", "key", "value"):
+                return (P(None, None, MODEL_AXIS, None)
+                        if field == "kernel" else P(None, MODEL_AXIS, None))
+            if name == "out" and field == "kernel":
+                return P(None, MODEL_AXIS, None, None)
+            if name == "fc1":
+                return (P(None, None, MODEL_AXIS)
+                        if field == "kernel" else P(None, MODEL_AXIS))
+            if name == "fc2" and field == "kernel":
+                return P(None, MODEL_AXIS, None)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: jax.device_put(
+            leaf, NamedSharding(mesh, spec(path))), params)
+
+
+class ServeEngine:
+    """Prefill + per-token decode over the paged pool; see the module
+    docstring for the step anatomy."""
+
+    def __init__(self, model, params: dict, cfg: ServeConfig | None = None,
+                 *, mesh=None, goodput=None, status=None):
+        self.cfg = cfg or ServeConfig()
+        self._validate_model(model)
+        self.model = model
+        self.mesh = mesh
+        self.dtype = model.dtype
+        self.attn_impl = model.attn_impl
+        if self.cfg.max_model_len > model.max_len:
+            raise ValueError(
+                f"max_model_len {self.cfg.max_model_len} exceeds the "
+                f"model's positional table ({model.max_len})")
+        if self.cfg.max_model_len % self.cfg.block_size:
+            raise ValueError(
+                f"max_model_len {self.cfg.max_model_len} must be a "
+                f"multiple of block_size {self.cfg.block_size} (the "
+                "decode program's block table is sized max_model_len / "
+                "block_size rows)")
+        if self.cfg.kv_quant == "int8":
+            import os
+
+            if os.environ.get("PAGED_IMPL", "xla") == "pallas":
+                raise ValueError(
+                    "kv_quant=int8 serves through the xla gather path "
+                    "only; unset PAGED_IMPL=pallas")
+        # template: scanned stacked layers (the one-compiled-block form)
+        import flax.linen as nn
+
+        from ..parallel.stacking import convert_tree_layout
+
+        params = nn.meta.unbox(params)  # fresh inits carry logical boxes
+        params = convert_tree_layout(params, "scanned", strict=False)
+        stacked_layers(params)  # validates the layout, refusal named
+        if mesh is not None:
+            from ..runtime.context import MODEL_AXIS
+
+            if model.num_heads % mesh.shape.get(MODEL_AXIS, 1):
+                raise ValueError(
+                    f"num_heads {model.num_heads} not divisible by the "
+                    f"model axis ({mesh.shape.get(MODEL_AXIS, 1)})")
+            params = place_for_serving(params, mesh)
+        self.params = params
+        self.kv = PagedKVCache(
+            num_layers=model.num_layers, num_heads=model.num_heads,
+            head_dim=model.head_dim, num_blocks=self.cfg.num_blocks,
+            block_size=self.cfg.block_size, dtype=self.dtype,
+            kv_quant=self.cfg.kv_quant)
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            from ..runtime.context import MODEL_AXIS
+
+            kv_spec = NamedSharding(
+                mesh, P(None, None, None, MODEL_AXIS, None))
+            sc_spec = NamedSharding(
+                mesh, P(None, None, None, MODEL_AXIS, None))
+            self.kv.pool = {
+                k: jax.device_put(v, sc_spec if k.endswith("_scale")
+                                  else kv_spec)
+                for k, v in self.kv.pool.items()}
+        self.max_blocks = self.cfg.max_model_len // self.cfg.block_size
+        self.scheduler = ContinuousScheduler(
+            self.cfg.max_slots, static_batch=self.cfg.static_batch)
+        self._buckets = self.cfg.buckets()
+        #: worst-case blocks committed per running/admitted sequence —
+        #: the no-preemption invariant (see scheduler module docstring)
+        self._committed: dict[int, int] = {}
+        self._goodput = goodput
+        self._status = status
+        if status is not None:
+            status.sources["serve"] = self.serve_state
+        # donation lets XLA update the pool in place; CPU ignores it
+        # with a warning per program, so gate on backend
+        donate = (1,) if jax.default_backend() == "tpu" else ()
+        self._prefill_fn = jax.jit(
+            functools.partial(self._prefill_math), donate_argnums=donate)
+        self._decode_fn = jax.jit(
+            functools.partial(self._decode_math), donate_argnums=donate)
+        self.steps = 0
+        self.tokens_out = 0
+        self._t0 = time.perf_counter()
+        self._prefill_s = 0.0
+        self._decode_s = 0.0
+
+    @staticmethod
+    def _validate_model(model) -> None:
+        for flag in ("moe_experts", "tp_overlap", "fsdp_overlap",
+                     "ddp_overlap"):
+            if getattr(model, flag, 0):
+                raise ValueError(
+                    f"serving template does not support {flag} (the "
+                    "engine runs the plain GSPMD math; model sharding "
+                    "comes from param placements) — export the "
+                    "checkpoint and serve it with the default template")
+        if getattr(model, "quant_compute", "off") != "off":
+            raise ValueError(
+                "serving with --quant_compute weights is not wired yet "
+                "(the serve forward runs the master weights); kv_quant "
+                "int8 covers the cache side")
+        if getattr(model, "attn_impl", "auto") in ("ring", "ulysses"):
+            raise ValueError(
+                "context-parallel attention has no serving path yet; "
+                "serve with attn_impl='auto'")
+
+    # -- jitted math -------------------------------------------------------
+    def _prefill_math(self, params, pool, ids, length, block_ids):
+        """One prompt: full forward, insert its KV blocks into the
+        pool, greedy-decode the first token from the last real
+        position. ``ids (1, T)`` bucket-padded; ``block_ids
+        (T/block_size,)`` physical targets (null-padded past the
+        prompt's blocks — scrap writes the mask never reads)."""
+        hidden, k, v = prefill_forward(
+            params, ids, dtype=self.dtype, attn_impl=self.attn_impl)
+        lyr, _, t, h, d = k.shape
+        nb = t // self.cfg.block_size
+        k = k.reshape(lyr, nb, self.cfg.block_size, h, d)
+        v = v.reshape(lyr, nb, self.cfg.block_size, h, d)
+        pool = dict(pool)
+        if self.cfg.kv_quant == "int8":
+            from .kv_cache import quantize_kv
+
+            kq, ks = quantize_kv(k)
+            vq, vs = quantize_kv(v)
+            pool["k"] = pool["k"].at[:, block_ids].set(kq)
+            pool["v"] = pool["v"].at[:, block_ids].set(vq)
+            pool["k_scale"] = pool["k_scale"].at[:, block_ids].set(ks)
+            pool["v_scale"] = pool["v_scale"].at[:, block_ids].set(vs)
+        else:
+            pool["k"] = pool["k"].at[:, block_ids].set(
+                k.astype(pool["k"].dtype))
+            pool["v"] = pool["v"].at[:, block_ids].set(
+                v.astype(pool["v"].dtype))
+        from ..ops.lm_head import greedy_decode
+
+        h_last = jnp.take(hidden[0], length - 1, axis=0)  # (E,)
+        nxt = greedy_decode(h_last[None], params["wte"]["embedding"],
+                            block=self.cfg.vocab_block)[0]
+        return nxt, pool
+
+    def _decode_math(self, params, pool, tokens, positions, tables,
+                     ctx_lens, write_blocks, write_offsets):
+        hidden, pool = decode_forward(
+            params, pool, tokens, positions, tables, ctx_lens,
+            write_blocks, write_offsets, dtype=self.dtype,
+            kv_quant=self.cfg.kv_quant)
+        from ..ops.lm_head import greedy_decode
+
+        nxt = greedy_decode(hidden, params["wte"]["embedding"],
+                            block=self.cfg.vocab_block)
+        return nxt, pool
+
+    # -- intake ------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 16) -> Request:
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) > self._buckets[-1]:
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds the largest "
+                f"prefill bucket ({self._buckets[-1]})")
+        if len(prompt) + max_new_tokens > self.cfg.max_model_len:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new_tokens {max_new_tokens} "
+                f"exceeds max_model_len {self.cfg.max_model_len}")
+        need = self.kv.blocks_needed(len(prompt) + max_new_tokens)
+        if need > self.kv.num_blocks - 1:
+            # refuse at submit: an unadmittable request would sit at the
+            # queue head forever (FCFS) starving everything behind it
+            raise ValueError(
+                f"request needs {need} KV blocks but the pool holds "
+                f"{self.kv.num_blocks - 1}; raise num_blocks or lower "
+                "max_new_tokens")
+        return self.scheduler.submit(prompt, max_new_tokens)
+
+    def _can_admit(self, req: Request) -> bool:
+        """Admission = reservation: the worst-case block count is
+        committed HERE, not at prefill — the scheduler approves a whole
+        wave before any prefill runs, and each member must see the
+        members admitted before it (the no-OOM invariant)."""
+        need = self.kv.blocks_needed(len(req.prompt) + req.max_new_tokens)
+        budget = self.kv.num_blocks - 1  # null block excluded
+        if sum(self._committed.values()) + need > budget:
+            return False
+        self._committed[req.id] = need
+        return True
+
+    # -- the engine step ---------------------------------------------------
+    def step(self) -> dict[str, Any]:
+        """One iteration of the serving loop: admit (+prefill), decode,
+        evict finished. Returns the flat stats record it published."""
+        admitted = self.scheduler.admit(self._can_admit)
+        t0 = time.perf_counter()
+        for req in admitted:
+            self._prefill_request(req)
+        prefill_dt = time.perf_counter() - t0 if admitted else 0.0
+        self._prefill_s += prefill_dt
+        t1 = time.perf_counter()
+        decode_dt = 0.0
+        if self.scheduler.running:
+            self._decode_step()
+            decode_dt = time.perf_counter() - t1
+            self._decode_s += decode_dt
+        self.steps += 1
+        if self._goodput is not None:
+            if prefill_dt:
+                self._goodput.add("serve_prefill", prefill_dt)
+            if decode_dt:
+                self._goodput.add("serve_decode", decode_dt)
+        if self._status is None:
+            return {}  # no sink: don't assemble gauges in the token path
+        rec = self.stats()
+        self._status.note_record("serve", self.steps, rec)
+        return rec
+
+    def _prefill_request(self, req: Request) -> None:
+        plen = len(req.prompt)
+        bucket = next(b for b in self._buckets if b >= plen)
+        self.kv.alloc(req.id, plen)  # worst case reserved at admission
+        nb_bucket = bucket // self.cfg.block_size
+        blocks = self.kv.table(req.id)
+        block_ids = np.full((nb_bucket,), NULL_BLOCK, np.int32)
+        block_ids[: len(blocks)] = blocks
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :plen] = req.prompt
+        nxt, self.kv.pool = self._prefill_fn(
+            self.params, self.kv.pool, jnp.asarray(ids),
+            jnp.int32(plen), jnp.asarray(block_ids))
+        tok = int(nxt)  # sync: TTFT is honest wall-clock
+        req.tokens.append(tok)
+        req.t_first_token = time.time()
+        self.tokens_out += 1
+        self._maybe_finish(req, tok)
+
+    def _decode_step(self) -> None:
+        s = self.cfg.max_slots
+        tokens = np.zeros((s,), np.int32)
+        positions = np.zeros((s,), np.int32)
+        ctx = np.zeros((s,), np.int32)
+        wb = np.full((s,), NULL_BLOCK, np.int32)
+        wo = np.zeros((s,), np.int32)
+        tables = np.full((s, self.max_blocks), NULL_BLOCK, np.int32)
+        running = dict(self.scheduler.running)
+        for slot, req in running.items():
+            pos = self.kv.seq_len(req.id)
+            blk, off = self.kv.append_slot(req.id)
+            tokens[slot] = req.tokens[-1]
+            positions[slot] = pos
+            ctx[slot] = pos + 1  # the token attends to itself
+            wb[slot], wo[slot] = blk, off
+            tables[slot] = self.kv.padded_table(req.id, self.max_blocks)
+        nxt, self.kv.pool = self._decode_fn(
+            self.params, self.kv.pool, jnp.asarray(tokens),
+            jnp.asarray(positions), jnp.asarray(tables),
+            jnp.asarray(ctx), jnp.asarray(wb), jnp.asarray(wo))
+        nxt = np.asarray(nxt)  # ONE host sync for the whole step
+        for slot, req in running.items():
+            tok = int(nxt[slot])
+            req.tokens.append(tok)
+            self.tokens_out += 1
+            self._maybe_finish(req, tok)
+
+    def _maybe_finish(self, req: Request, tok: int) -> None:
+        done = len(req.tokens) >= req.max_new_tokens
+        if self.cfg.eos_id is not None and tok == self.cfg.eos_id:
+            done = True
+        if done:
+            self.scheduler.finish(req)
+            self.kv.free(req.id)
+            self._committed.pop(req.id, None)
+
+    def run(self, max_steps: int = 100_000) -> dict[int, list[int]]:
+        """Drive :meth:`step` until idle; ``{request_id: tokens}``."""
+        for _ in range(max_steps):
+            if self.scheduler.idle():
+                break
+            self.step()
+        return {rid: list(r.tokens)
+                for rid, r in self.scheduler.finished.items()}
+
+    # -- reporting ---------------------------------------------------------
+    def decode_programs(self) -> int:
+        """Compiled decode-program count — the zero-recompile pin
+        (must stay 1 however sequences grow)."""
+        return self._decode_fn._cache_size()
+
+    def prefill_programs(self) -> int:
+        return self._prefill_fn._cache_size()
+
+    def stats(self) -> dict[str, Any]:
+        """Flat SLO/capacity gauges, ``serve_``-prefixed — the record
+        published to ``/status`` (kind ``serve``) and exported as
+        ``tpuddp_serve_*`` on ``/metrics``."""
+        wall = max(time.perf_counter() - self._t0, 1e-9)
+        kv = self.kv.stats()
+        slo = self.scheduler.slo_summary()
+        n_dev = jax.device_count()
+        rec: dict[str, Any] = {
+            "serve_queue_depth": self.scheduler.queue_depth(),
+            "serve_active": self.scheduler.active(),
+            "serve_finished_total": slo["finished"],
+            "serve_tokens_total": self.tokens_out,
+            "serve_tokens_per_sec": self.tokens_out / wall,
+            "serve_tokens_per_sec_per_chip": self.tokens_out / wall / n_dev,
+            "serve_blocks_used": kv["blocks_used"],
+            "serve_blocks_free": kv["blocks_free"],
+            "serve_frag_slots": kv["frag_slots"],
+            "serve_kv_high_water_blocks": kv["high_water_blocks"],
+            "serve_kv_bytes_per_token": kv["bytes_per_token"],
+            "serve_prefill_s_total": self._prefill_s,
+            "serve_decode_s_total": self._decode_s,
+            "serve_decode_programs": self.decode_programs(),
+            "serve_prefill_programs": self.prefill_programs(),
+            "serve_steps": self.steps,
+        }
+        if slo["ttft_s_mean"] is not None:
+            rec["serve_ttft_ms_mean"] = slo["ttft_s_mean"] * 1e3
+        if slo["ttft_s_max"] is not None:
+            rec["serve_ttft_ms_max"] = slo["ttft_s_max"] * 1e3
+        if slo["per_token_s_mean"] is not None:
+            rec["serve_per_token_ms_mean"] = slo["per_token_s_mean"] * 1e3
+        return rec
+
+    def serve_state(self) -> dict[str, Any]:
+        """The ``/status`` source: gauges + engine geometry."""
+        return {
+            **self.stats(),
+            "config": dataclasses.asdict(self.cfg),
+            "buckets": list(self._buckets),
+        }
+
+    # -- the checkpoint seam -----------------------------------------------
+    @classmethod
+    def from_checkpoint(cls, directory, model,
+                        cfg: ServeConfig | None = None, *, step=None,
+                        mesh=None, goodput=None, status=None
+                        ) -> "ServeEngine":
+        """Serve a TRAINING checkpoint directly: template-free read
+        (``restore_raw`` — falls back past torn steps), the r18 layout
+        converter restacks scanned/unrolled/pipelined into the serving
+        template, and the params place onto ``mesh``. The optimizer
+        state rides along in the raw read and is dropped here — serving
+        wants the params leaf only."""
+        from ..checkpoint.manager import CheckpointManager
+
+        mngr = CheckpointManager(directory)
+        try:
+            step_n, state, _cfg = mngr.restore_raw(step)
+        finally:
+            mngr.close()
+        params = state.get("params") if isinstance(state, dict) else None
+        if params is None:
+            raise ValueError(
+                f"checkpoint at {directory} holds no 'params' item — "
+                "not a training-state checkpoint this engine can serve")
+        log.info("serving checkpoint", {"dir": str(directory),
+                                        "step": step_n})
+        return cls(model, params, cfg, mesh=mesh, goodput=goodput,
+                   status=status)

@@ -1,0 +1,182 @@
+"""Serving forward passes: the GPT decoder as pure functions over the
+scanned param tree.
+
+Training applies the model through flax modules; serving wants two
+*different* programs over the SAME parameters — a bucketed full-context
+prefill and a one-token-per-sequence decode reading the paged KV cache
+— and neither fits the module's ``__call__`` (which recomputes every
+position's KV every token). This module re-expresses the
+``models/gpt.GptDecoder`` math as pure functions over the scanned
+``{"wte", "wpe", "decoder": {"layers": stacked}, "final_ln"}`` tree:
+
+- the primitive sequence matches flax's exactly (``lax.dot_general``
+  with DenseGeneral's dimension numbers, the fast-variance LayerNorm,
+  ``jax.nn.gelu``), so :func:`prefill_forward` is **bit-identical** to
+  ``GptDecoder(fused_head=True).apply`` on the prompt — the
+  checkpoint→serving seam is testable as equality, not tolerance;
+- both passes drive ONE ``lax.scan`` over the stacked layer weights
+  (the r7 compile-time contract), and the decode scan threads the KV
+  pool's layer axis as scan xs/ys — layer ``l``'s blocks are read and
+  written inside iteration ``l``, never gathered whole.
+
+Supported template: the plain GSPMD path (no tp_overlap/MoE/pipe —
+the engine refuses those with intent; model sharding comes from the
+params'/pool's NamedShardings, GSPMD partitions these functions like
+any other jitted program).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.attention import attention
+from .decode_ops import paged_attention
+from .kv_cache import quantize_kv
+
+
+def layer_norm(x: jax.Array, p: dict) -> jax.Array:
+    """flax ``nn.LayerNorm(dtype=f32)`` exactly: fast-variance stats
+    (``E[x^2] - E[x]^2`` clipped at 0), ``rsqrt``, scale-into-mul —
+    the same primitive sequence, for bitwise parity."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    mean2 = jnp.mean(lax.square(xf), axis=-1, keepdims=True)
+    var = jnp.maximum(0.0, mean2 - lax.square(mean))
+    y = xf - mean
+    mul = lax.rsqrt(var + 1e-6) * p["scale"].astype(jnp.float32)
+    return y * mul + p["bias"].astype(jnp.float32)
+
+
+def dense(x: jax.Array, p: dict, n_axes: int, dtype) -> jax.Array:
+    """``nn.DenseGeneral`` contraction over the trailing ``n_axes``
+    dims of ``x`` (kernel's leading dims), bias broadcast-added."""
+    x = x.astype(dtype)
+    kernel = p["kernel"].astype(dtype)
+    axes = tuple(range(x.ndim - n_axes, x.ndim))
+    kaxes = tuple(range(n_axes))
+    y = lax.dot_general(x, kernel, ((axes, kaxes), ((), ())))
+    return y + p["bias"].astype(dtype)
+
+
+def embed_tokens(params: dict, input_ids: jax.Array, positions: jax.Array,
+                 dtype) -> jax.Array:
+    """``wte[ids] + wpe[pos]`` — the flax ``nn.Embed`` lookups."""
+    wte = params["wte"]["embedding"].astype(dtype)
+    wpe = params["wpe"]["embedding"].astype(dtype)
+    return jnp.take(wte, input_ids, axis=0) + jnp.take(wpe, positions, axis=0)
+
+
+def stacked_layers(params: dict) -> dict:
+    """The scanned ``(L, ...)`` block-param stack of the decoder."""
+    layers = params["decoder"].get("layers")
+    if layers is None:
+        raise ValueError(
+            "serving template needs the scanned layer layout "
+            "(decoder/layers stacked params); run the checkpoint through "
+            "parallel.stacking.convert_tree_layout(..., 'scanned') — "
+            "ServeEngine.from_checkpoint does this automatically")
+    return layers
+
+
+def _attn_qkv(p: dict, x: jax.Array, dtype):
+    q = dense(x, p["attention"]["query"], 1, dtype)
+    k = dense(x, p["attention"]["key"], 1, dtype)
+    v = dense(x, p["attention"]["value"], 1, dtype)
+    return q, k, v
+
+
+def _block_prefill(p: dict, x: jax.Array, dtype, attn_impl: str):
+    """One pre-LN decoder block over the full prompt ``x (B, T, E)``;
+    returns ``(x, (k, v))`` with the block's KV for cache insertion."""
+    h = layer_norm(x, p["ln_attn"]).astype(dtype)
+    q, k, v = _attn_qkv(p, h, dtype)
+    a = attention(q, k, v, causal=True, impl=attn_impl)
+    a = dense(a, p["attention"]["out"], 2, dtype)
+    x = x + a
+    h = layer_norm(x, p["ln_mlp"]).astype(dtype)
+    h = dense(h, p["mlp"]["fc1"], 1, dtype)
+    h = jax.nn.gelu(h)
+    h = dense(h, p["mlp"]["fc2"], 1, dtype)
+    return x + h, (k, v)
+
+
+def prefill_forward(params: dict, input_ids: jax.Array, *, dtype,
+                    attn_impl: str = "auto"):
+    """Full-context forward of the prompt batch ``(B, T)``.
+
+    Returns ``(hidden, k, v)``: ``hidden (B, T, E)`` after the final
+    LayerNorm (exactly ``GptDecoder(fused_head=True).apply``), and the
+    per-layer KV ``(L, B, T, H, D)`` for paged-cache insertion.
+    """
+    t = input_ids.shape[1]
+    x = embed_tokens(params, input_ids, jnp.arange(t), dtype)
+
+    def body(carry, p):
+        y, kv = _block_prefill(p, carry, dtype, attn_impl)
+        return y, kv
+
+    x, (k, v) = lax.scan(body, x, stacked_layers(params))
+    hidden = layer_norm(x, params["final_ln"]).astype(dtype)
+    return hidden, k, v
+
+
+def _write_pool(pool_l: dict, key: str, val: jax.Array,
+                write_blocks: jax.Array, write_offsets: jax.Array,
+                kv_quant: str) -> dict:
+    """Scatter one decode step's ``val (S, H, D)`` into the layer's
+    physical blocks at ``(write_blocks, write_offsets)`` per slot.
+    Inactive slots target the null block (the engine points them
+    there) — a harmless dump the mask never reads."""
+    out = dict(pool_l)
+    if kv_quant == "int8":
+        q, s = quantize_kv(val)
+        out[key] = pool_l[key].at[write_blocks, write_offsets].set(q)
+        out[key + "_scale"] = pool_l[key + "_scale"].at[
+            write_blocks, write_offsets].set(s)
+    else:
+        out[key] = pool_l[key].at[write_blocks, write_offsets].set(
+            val.astype(pool_l[key].dtype))
+    return out
+
+
+def decode_forward(params: dict, pool: dict, token_ids: jax.Array,
+                   positions: jax.Array, tables: jax.Array,
+                   context_lens: jax.Array, write_blocks: jax.Array,
+                   write_offsets: jax.Array, *, dtype,
+                   kv_quant: str = "off"):
+    """One decode step for ``S`` slots: embed the last token, run the
+    scanned stack with per-layer (write-KV → paged attention), final
+    LayerNorm. Returns ``(hidden (S, E), pool)`` with the pool's layer
+    axis updated in the same scan that consumed it.
+
+    ``context_lens`` INCLUDE the token being decoded (its KV is written
+    before the gather, so a token attends to itself — the causal
+    diagonal); inactive slots carry ``context_len 0`` and a null-block
+    write target, and their hidden rows are garbage the engine ignores.
+    """
+    x = embed_tokens(params, token_ids, positions, dtype)  # (S, E)
+
+    def body(carry, layer):
+        p, pool_l = layer
+        h = layer_norm(carry, p["ln_attn"]).astype(dtype)
+        q, k, v = _attn_qkv(p, h, dtype)                   # (S, H, D)
+        pool_l = _write_pool(pool_l, "k", k, write_blocks, write_offsets,
+                             kv_quant)
+        pool_l = _write_pool(pool_l, "v", v, write_blocks, write_offsets,
+                             kv_quant)
+        a = paged_attention(
+            q, pool_l["k"], pool_l["v"], tables, context_lens,
+            k_scale=pool_l.get("k_scale"), v_scale=pool_l.get("v_scale"))
+        a = dense(a, p["attention"]["out"], 2, dtype)
+        y = carry + a
+        h = layer_norm(y, p["ln_mlp"]).astype(dtype)
+        h = dense(h, p["mlp"]["fc1"], 1, dtype)
+        h = jax.nn.gelu(h)
+        h = dense(h, p["mlp"]["fc2"], 1, dtype)
+        return y + h, pool_l
+
+    x, pool = lax.scan(body, x, (stacked_layers(params), pool))
+    hidden = layer_norm(x, params["final_ln"]).astype(dtype)
+    return hidden, pool

@@ -1,0 +1,167 @@
+"""Continuous-batching scheduler: iteration-level admission and
+eviction (Orca, Yu et al. OSDI'22).
+
+Static batching forms a batch, decodes until EVERY member finishes,
+and only then admits again — the batch runs at the speed of its
+longest member while finished slots burn idle decode lanes. Continuous
+batching re-decides membership every step: finished sequences leave at
+the step they finish, queued sequences join the moment a slot AND the
+KV blocks are free. The scheduler owns the host-side bookkeeping
+(queue, slot map, per-request timing); the capacity question is
+delegated to the engine's block accounting (``can_admit`` callback),
+so admission is joint over the two real resources — decode slots and
+KV blocks — and never over tensor shapes.
+
+Admission commits worst-case KV blocks (prompt + max_new_tokens): a
+running sequence can always grow to its limit without preemption.
+That is deliberately conservative next to vLLM's optimistic
+admission + preempt-on-OOM — preemption needs KV swap/recompute
+machinery this engine doesn't carry yet; the committed-blocks ledger
+makes the no-OOM guarantee a one-line invariant instead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Callable
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request through its life: queued → running →
+    finished. ``tokens`` accumulates the generated ids; timing fields
+    feed the SLO metrics (TTFT = first token - submit)."""
+
+    id: int
+    prompt: list[int]
+    max_new_tokens: int
+    state: str = "queued"
+    tokens: list[int] = dataclasses.field(default_factory=list)
+    slot: int | None = None
+    t_submit: float = 0.0
+    t_first_token: float | None = None
+    t_finished: float | None = None
+
+    @property
+    def ttft_s(self) -> float | None:
+        if self.t_first_token is None:
+            return None
+        return self.t_first_token - self.t_submit
+
+    @property
+    def per_token_s(self) -> float | None:
+        """Mean inter-token latency over the decode phase (excludes
+        TTFT — prefill is its own SLO)."""
+        if self.t_finished is None or self.t_first_token is None:
+            return None
+        n = len(self.tokens) - 1
+        if n <= 0:
+            return None
+        return (self.t_finished - self.t_first_token) / n
+
+
+class ContinuousScheduler:
+    """Admission queue + slot map for ``max_slots`` decode lanes.
+
+    ``static_batch=True`` degrades to wave admission (admit only into
+    an EMPTY engine, drain fully) — the ablation baseline the
+    ``BENCH_MODE=serve`` continuous-vs-static leg measures against.
+    """
+
+    def __init__(self, max_slots: int, *, static_batch: bool = False):
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        self.max_slots = max_slots
+        self.static_batch = static_batch
+        self.queue: deque[Request] = deque()
+        self.running: dict[int, Request] = {}  # slot -> request
+        self.finished: dict[int, Request] = {}  # id -> request
+        self._next_id = 0
+        # running SLO aggregates — slo_summary() must stay O(1): the
+        # engine publishes it every decode step, and rescanning
+        # `finished` would grow the per-token host cost with lifetime
+        # requests served
+        self._ttft_sum = 0.0
+        self._ttft_max = 0.0
+        self._ttft_n = 0
+        self._pt_sum = 0.0
+        self._pt_n = 0
+
+    # -- intake ------------------------------------------------------------
+    def submit(self, prompt: list[int], max_new_tokens: int,
+               *, now: float | None = None) -> Request:
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        req = Request(id=self._next_id, prompt=list(prompt),
+                      max_new_tokens=int(max_new_tokens),
+                      t_submit=time.time() if now is None else now)
+        self._next_id += 1
+        self.queue.append(req)
+        return req
+
+    # -- membership --------------------------------------------------------
+    def free_slots(self) -> list[int]:
+        return [s for s in range(self.max_slots) if s not in self.running]
+
+    def admit(self, can_admit: Callable[[Request], bool]) -> list[Request]:
+        """Move queue heads into free slots while ``can_admit`` (the
+        engine's block-budget check) holds — FCFS, no reordering (a
+        blocked head blocks the queue: cheap head-of-line fairness;
+        size-aware reordering is a policy for later). Static mode only
+        admits into an empty engine (the wave)."""
+        if self.static_batch and self.running:
+            return []
+        admitted = []
+        slots = self.free_slots()
+        while self.queue and slots:
+            req = self.queue[0]
+            if not can_admit(req):
+                break
+            self.queue.popleft()
+            req.slot = slots.pop(0)
+            req.state = "running"
+            self.running[req.slot] = req
+            admitted.append(req)
+        return admitted
+
+    def finish(self, req: Request, *, now: float | None = None) -> None:
+        """Per-step eviction of a finished sequence: the slot frees at
+        THIS step's boundary (the continuous-batching move)."""
+        req.state = "finished"
+        req.t_finished = time.time() if now is None else now
+        if req.slot is not None:
+            self.running.pop(req.slot, None)
+            req.slot = None
+        self.finished[req.id] = req
+        if req.ttft_s is not None:
+            self._ttft_sum += req.ttft_s
+            self._ttft_max = max(self._ttft_max, req.ttft_s)
+            self._ttft_n += 1
+        if req.per_token_s is not None:
+            self._pt_sum += req.per_token_s
+            self._pt_n += 1
+
+    # -- reporting ---------------------------------------------------------
+    def queue_depth(self) -> int:
+        return len(self.queue)
+
+    def active(self) -> int:
+        return len(self.running)
+
+    def idle(self) -> bool:
+        return not self.queue and not self.running
+
+    def slo_summary(self) -> dict[str, Any]:
+        """TTFT / per-token latency over everything finished so far —
+        O(1) from the running aggregates (published every step)."""
+        return {
+            "ttft_s_mean": (self._ttft_sum / self._ttft_n
+                            if self._ttft_n else None),
+            "ttft_s_max": self._ttft_max if self._ttft_n else None,
+            "per_token_s_mean": (self._pt_sum / self._pt_n
+                                 if self._pt_n else None),
+            "finished": len(self.finished),
+        }

@@ -389,8 +389,10 @@ class Trainer:
         if config.supervise != "off":
             from .supervisor import Supervisor
 
-            self.supervisor = Supervisor(config.supervise,
-                                         config.output_dir)
+            self.supervisor = Supervisor(
+                config.supervise, config.output_dir,
+                cooldown_s=config.supervise_cooldown_s,
+                evict_budget_per_day=config.supervise_evict_budget)
         # deterministic fault injection (--inject_fault): the elastic
         # test harness; fires in the loop after the save blocks
         from .supervisor import FaultInjector

@@ -84,16 +84,49 @@ VERDICT_ACTIONS = {
 #: actions that stop the run (and therefore fire at most once)
 _STOPPING = ("evict", "restart")
 
+_DAY_S = 86_400.0
+
 
 class Supervisor:
     """Evaluate confirmed verdicts against the action table; the engine
-    executes (act) or logs (warn) what :meth:`poll` hands it."""
+    executes (act) or logs (warn) what :meth:`poll` hands it.
 
-    def __init__(self, mode: str, output_dir: str | Path):
+    **Hysteresis (r19, ROADMAP r18 open (d))** — two guards keep a
+    flapping host from evict-looping the fleet, both enforced from the
+    ``supervisor.json`` decision ledger this class already writes (so
+    they hold ACROSS attempts — the loop is exactly a restart cycle):
+
+    - *cooldown*: a stopping verdict landing within ``cooldown_s`` of
+      the previous acted stop is downgraded to observe-only (recorded
+      with ``suppressed: "cooldown"``). A host that goes sick, gets
+      evicted, and immediately re-triggers on the resumed subset gets
+      one recovery window before the supervisor may stop the run again.
+    - *eviction budget*: at most ``evict_budget_per_day`` acted
+      evictions in any trailing 24 h, counted over the persisted ledger
+      plus this attempt (``suppressed: "budget"`` past it). Restarts
+      (mem_pressure) spend cooldown but not the eviction budget — they
+      drain no host.
+
+    Suppressed verdicts still land in the decision log and
+    ``/status`` — the operator sees what the policy refused and why.
+    """
+
+    def __init__(self, mode: str, output_dir: str | Path, *,
+                 cooldown_s: float = 600.0,
+                 evict_budget_per_day: int = 4):
         if mode not in ("warn", "act"):
             raise ValueError(f"unknown supervisor mode {mode!r}; "
                              "expected warn | act")
+        if cooldown_s < 0:
+            raise ValueError(
+                f"supervisor cooldown_s must be >= 0, got {cooldown_s}")
+        if evict_budget_per_day < 0:
+            raise ValueError(
+                "supervisor evict_budget_per_day must be >= 0 "
+                f"(0 = unlimited), got {evict_budget_per_day}")
         self.mode = mode
+        self.cooldown_s = float(cooldown_s)
+        self.evict_budget_per_day = int(evict_budget_per_day)
         self.path = Path(output_dir) / FILENAME
         self._lock = threading.Lock()
         #: serialises _write() — on_verdict (drain thread) and
@@ -105,6 +138,62 @@ class Supervisor:
         self._pending: dict[str, Any] | None = None
         self._delivered = False
         self.decisions: list[dict[str, Any]] = []
+        #: acted stopping decisions from PRIOR attempts' ledger
+        #: (``(time, action)`` pairs) — what cooldown/budget meter
+        self._prior_stops: list[tuple[float, str]] = self._load_prior_stops()
+
+    def _load_prior_stops(self) -> list[tuple[float, str]]:
+        """Best-effort read of the previous attempts' acted stopping
+        decisions from the ledger on disk; a missing or corrupt file is
+        a fresh history, never an error."""
+        try:
+            if not self.path.is_file():
+                return []
+            doc = json.loads(self.path.read_text())
+            # older attempts' stops ride the ledger's own stop_history
+            # (each attempt rewrites the file; the history key is how a
+            # third attempt still sees the first one's evictions)
+            stops = [
+                (float(t), str(a))
+                for t, a in doc.get("stop_history", [])
+                if isinstance(t, (int, float)) and a in _STOPPING
+            ]
+            stops += [
+                (float(d.get("time", 0.0)), str(d.get("action")))
+                for d in doc.get("decisions", [])
+                if d.get("acted") and d.get("action") in _STOPPING
+                and isinstance(d.get("time"), (int, float))
+            ]
+            # bound the carried history: nothing older than the 24h
+            # budget window matters once the cooldown has also lapsed
+            horizon = time.time() - 2 * _DAY_S
+            return sorted((t, a) for t, a in stops if t >= horizon)
+        except Exception:  # noqa: BLE001 - policy must not kill startup
+            log.exception("supervisor.json unreadable; hysteresis "
+                          "starts with a fresh history")
+            return []
+
+    def _all_stops(self) -> list[tuple[float, str]]:
+        """Acted stopping decisions, prior attempts + this one; call
+        under ``self._lock``."""
+        return self._prior_stops + [
+            (float(d["time"]), d["action"]) for d in self.decisions
+            if d["acted"] and d["action"] in _STOPPING]
+
+    def _hysteresis_veto(self, action: str, now: float) -> str | None:
+        """Why ``action`` may not claim the stop right now, or None.
+        Call under ``self._lock``."""
+        stops = self._all_stops()
+        if self.cooldown_s > 0 and stops:
+            last = max(t for t, _ in stops)
+            if now - last < self.cooldown_s:
+                return "cooldown"
+        if action == "evict" and self.evict_budget_per_day > 0:
+            recent = sum(1 for t, a in stops
+                         if a == "evict" and now - t < _DAY_S)
+            if recent >= self.evict_budget_per_day:
+                return "budget"
+        return None
 
     # -- drain-thread side -------------------------------------------------
     def on_verdict(self, kind: str, step: int,
@@ -117,6 +206,7 @@ class Supervisor:
             action = VERDICT_ACTIONS.get(kind, "observe")
             scalars = dict(verdict or {})
             host = scalars.get("host")
+            now = time.time()
             decision = {
                 "kind": kind,
                 "action": action,
@@ -124,13 +214,21 @@ class Supervisor:
                 "host": int(host) if host is not None else None,
                 "mode": self.mode,
                 "acted": False,
-                "time": time.time(),
+                "time": now,
+                "suppressed": None,
                 "verdict": scalars,
             }
             claim = False
+            suppressed = None
             with self._lock:
+                if action in _STOPPING:
+                    suppressed = self._hysteresis_veto(action, now)
+                    if suppressed is not None:
+                        decision["action"] = "observe"
+                        decision["suppressed"] = suppressed
                 self.decisions.append(decision)
-                if (action in _STOPPING and self._pending is None):
+                if (decision["action"] in _STOPPING
+                        and self._pending is None):
                     claim = True
                     self._pending = decision
             if claim:
@@ -139,6 +237,15 @@ class Supervisor:
                     kind, int(step), action,
                     f" host {int(host)}" if host is not None else "",
                     self.mode)
+            elif suppressed is not None:
+                log.warning(
+                    "supervisor: %s verdict at step %d would %s but the "
+                    "%s guard vetoed it (%s) — recorded observe-only",
+                    kind, int(step), action, suppressed,
+                    "a stop landed inside the cooldown window"
+                    if suppressed == "cooldown" else
+                    f"{self.evict_budget_per_day} acted evictions in the "
+                    "trailing 24h exhaust the budget")
             elif action == "observe":
                 log.info(
                     "supervisor: %s verdict at step %d recorded "
@@ -193,10 +300,14 @@ class Supervisor:
         with self._lock:
             return {
                 "mode": self.mode,
+                "cooldown_s": self.cooldown_s,
+                "evict_budget_per_day": self.evict_budget_per_day,
                 "decisions": [dict(d) for d in self.decisions],
                 "pending": (dict(self._pending)
                             if self._pending is not None else None),
                 "acted": any(d["acted"] for d in self.decisions),
+                "suppressed_total": sum(
+                    1 for d in self.decisions if d.get("suppressed")),
             }
 
     def _write(self) -> None:
@@ -205,9 +316,12 @@ class Supervisor:
             return
         try:
             with self._write_lock:
+                with self._lock:
+                    history = list(self._prior_stops)
                 payload = {
                     "schema": "supervisor/v1",
                     **self.state(),
+                    "stop_history": history,
                     "eviction": self.eviction(),
                     "note": "decisions the supervisor took (act) or "
                             "would have taken (warn); `eviction` is the "

@@ -366,6 +366,86 @@ class TestSupervisor:
         assert 'evicted_host="2"' in text
 
 
+# -- supervisor hysteresis (r19, ROADMAP r18 open (d)) ---------------------
+#
+# A flapping host passes the straggler attribution every time it flaps;
+# without hysteresis each flap becomes checkpoint -> evict -> resume and
+# the fleet spends its life restarting. Two guards, both enforced from
+# the supervisor.json ledger so they hold ACROSS attempts: a cooldown
+# after any acted stop, and a max-K-evictions-per-day budget. The tests
+# inject the flapping verdicts directly (the production path delivers
+# them through on_verdict either way).
+
+
+class TestSupervisorHysteresis:
+    def evict_once(self, d, **kw):
+        s = Supervisor("act", d, **kw)
+        s.on_verdict("straggler", 10, {"host": 2})
+        dec = s.poll()
+        assert dec is not None and dec["action"] == "evict"
+        s.mark_acted(dec)
+        return s
+
+    def test_flapping_host_hits_cooldown_across_attempts(self, tmp_path):
+        self.evict_once(tmp_path, cooldown_s=600)
+        # the relaunch: the SAME host flaps again immediately
+        s2 = Supervisor("act", tmp_path, cooldown_s=600)
+        s2.on_verdict("straggler", 12, {"host": 2})
+        assert s2.poll() is None  # vetoed: no second stop
+        doc = json.loads((tmp_path / "supervisor.json").read_text())
+        last = doc["decisions"][-1]
+        assert last["suppressed"] == "cooldown"
+        assert last["action"] == "observe"
+        assert doc["suppressed_total"] == 1
+
+    def test_eviction_budget_from_ledger(self, tmp_path):
+        # two acted evictions across two attempts exhaust a budget of 2
+        self.evict_once(tmp_path, cooldown_s=0, evict_budget_per_day=2)
+        self.evict_once(tmp_path, cooldown_s=0, evict_budget_per_day=2)
+        s3 = Supervisor("act", tmp_path, cooldown_s=0,
+                        evict_budget_per_day=2)
+        s3.on_verdict("straggler", 30, {"host": 0})
+        assert s3.poll() is None
+        doc = json.loads((tmp_path / "supervisor.json").read_text())
+        assert doc["decisions"][-1]["suppressed"] == "budget"
+        # the stop history is carried forward, not just the last attempt
+        assert len(doc["stop_history"]) >= 1
+
+    def test_restart_spends_cooldown_not_evict_budget(self, tmp_path):
+        self.evict_once(tmp_path, cooldown_s=0, evict_budget_per_day=1)
+        s2 = Supervisor("act", tmp_path, cooldown_s=0,
+                        evict_budget_per_day=1)
+        # budget exhausted for evict...
+        s2.on_verdict("straggler", 20, {"host": 1})
+        assert s2.poll() is None
+        # ...but a mem_pressure restart drains no host: still allowed
+        s3 = Supervisor("act", tmp_path, cooldown_s=0,
+                        evict_budget_per_day=1)
+        s3.on_verdict("mem_pressure", 21, {})
+        assert s3.poll()["action"] == "restart"
+
+    def test_zero_disables_the_guards(self, tmp_path):
+        self.evict_once(tmp_path, cooldown_s=0, evict_budget_per_day=0)
+        s2 = Supervisor("act", tmp_path, cooldown_s=0,
+                        evict_budget_per_day=0)
+        s2.on_verdict("straggler", 11, {"host": 2})
+        assert s2.poll()["action"] == "evict"  # immediate re-evict allowed
+
+    def test_corrupt_ledger_starts_fresh(self, tmp_path):
+        (tmp_path / "supervisor.json").write_text("{not json")
+        s = Supervisor("act", tmp_path, cooldown_s=600)
+        s.on_verdict("straggler", 5, {"host": 1})
+        assert s.poll()["action"] == "evict"  # no invented history
+
+    def test_state_reports_guards(self, tmp_path):
+        s = Supervisor("warn", tmp_path, cooldown_s=120,
+                       evict_budget_per_day=3)
+        st = s.state()
+        assert st["cooldown_s"] == 120
+        assert st["evict_budget_per_day"] == 3
+        assert st["suppressed_total"] == 0
+
+
 # -- goodput buckets -------------------------------------------------------
 
 class TestGoodputElasticBuckets:

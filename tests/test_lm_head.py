@@ -230,3 +230,79 @@ def test_peak_memory_scales_with_block_not_vocab():
     # dense holds >= one full (B,T,V) f32 logits tensor in temps
     assert dense_tmp > b * t * v * 4
     assert fused_tmp < dense_tmp / 5, (fused_tmp, dense_tmp)
+
+
+# -- greedy decode: the standalone online-argmax primitive (r19) -----------
+#
+# Until r19 the running argmax was only exercised through the loss path's
+# accuracy metric; the serving engine now drives it directly, so the
+# primitive gets direct pins — including the visit-order tie-break
+# invariant the TP ring head has always silently relied on.
+
+
+class TestGreedyDecode:
+    def test_matches_dense_argmax_across_blockings(self, case):
+        from pytorch_ddp_template_tpu.ops.lm_head import greedy_decode
+
+        hidden, table, _ = case
+        ref = np.asarray(jnp.argmax(
+            hidden.astype(jnp.float32) @ table.astype(jnp.float32).T, -1))
+        for block in (8192, 64, 100, 7):  # incl. non-dividing widths
+            got = np.asarray(greedy_decode(hidden, table, block=block))
+            assert np.array_equal(got, ref), block
+
+    def test_bias_applied(self, case):
+        from pytorch_ddp_template_tpu.ops.lm_head import greedy_decode
+
+        hidden, table, _ = case
+        v = table.shape[0]
+        # a bias spike forces every position to the spiked id
+        bias = jnp.zeros((v,), jnp.float32).at[17].set(1e4)
+        got = np.asarray(greedy_decode(hidden, table, bias=bias, block=50))
+        assert np.all(got == 17)
+
+    def test_tie_break_invariant_across_visit_orders(self):
+        """Exact ties break toward the LOWEST vocab id regardless of
+        which block visits first: duplicate table rows land in
+        different blocks under different block widths (different visit
+        orders), and every blocking must pick the lower id."""
+        from pytorch_ddp_template_tpu.ops.lm_head import greedy_decode
+
+        rng = np.random.default_rng(0)
+        v, e = 300, 16
+        table = rng.standard_normal((v, e)).astype(np.float32)
+        table[257] = table[3]  # exact duplicate -> exact logit tie
+        # make the duplicated row the winner for every query
+        hidden = jnp.asarray(np.tile(table[3] * 10.0, (4, 1)))
+        table = jnp.asarray(table)
+        for block in (300, 128, 64, 10, 7):
+            got = np.asarray(greedy_decode(hidden, table, block=block))
+            assert np.all(got == 3), (block, got)
+
+    def test_agrees_with_loss_path_argmax(self, case):
+        """The extracted primitive and the loss bundle's accuracy argmax
+        are the same computation — pinned so a future edit to one
+        cannot silently fork the other."""
+        from pytorch_ddp_template_tpu.ops.lm_head import greedy_decode
+
+        hidden, table, targets = case
+        _, best = lm_head_loss(hidden, table, targets, block=64)
+        got = greedy_decode(hidden, table, block=64)
+        assert np.array_equal(np.asarray(best), np.asarray(got))
+
+    def test_no_full_logits_materialised(self):
+        """Peak temp memory scales with the vocab BLOCK, not the vocab:
+        the serving-decode memory contract. Block-aligned vocab so the
+        measurement sees the logits rows, not a one-off pad copy of the
+        table (the pad path is covered functionally above)."""
+        rng = np.random.default_rng(2)
+        v, e, b = 49_152, 64, 32
+        hidden = jnp.asarray(rng.standard_normal((b, e)), jnp.float32)
+        table = jnp.asarray(rng.standard_normal((v, e)), jnp.float32)
+        from pytorch_ddp_template_tpu.ops.lm_head import greedy_decode
+
+        c = jax.jit(
+            lambda h, t: greedy_decode(h, t, block=2048)
+        ).lower(hidden, table).compile()
+        tmp = c.memory_analysis().temp_size_in_bytes
+        assert tmp < b * v * 4 / 5, tmp  # far below a (B, V) logits row

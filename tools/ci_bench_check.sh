@@ -17,6 +17,12 @@
 #                                               # MTTR + fallback legs) and
 #                                               # gate it vs the committed
 #                                               # elastic record
+#   RUN_SERVE=1 bash tools/ci_bench_check.sh    # r19: run BENCH_MODE=serve
+#                                               # fresh (CPU: continuous-vs-
+#                                               # static tokens/sec, the
+#                                               # zero-recompile pin, live
+#                                               # gauges) and gate it vs the
+#                                               # committed serve record
 #
 # Exit codes are bench_diff's: 0 in-band, 1 drift, 2 no overlap/usage
 # (an empty comparison must not read as green). Output is the github
@@ -27,15 +33,28 @@ R=bench_records
 CANDIDATE=${1:-$R}
 TOLERANCE=${TOLERANCE:-0.25}
 
+# fresh-leg flags share ONE scratch dir so RUN_SERVE=1 RUN_ELASTIC=1
+# gates both records (a later block overwriting CANDIDATE would silently
+# discard the earlier run)
+if [ "${RUN_SERVE:-0}" = "1" ] || [ "${RUN_ELASTIC:-0}" = "1" ]; then
+  FRESH_DIR=$(mktemp -d)
+  CANDIDATE=$FRESH_DIR
+fi
+
+if [ "${RUN_SERVE:-0}" = "1" ]; then
+  # the serve leg runs the mixed-length workload on a warmed engine
+  # (compile pass + timed pass per policy)
+  BENCH_CPU=${BENCH_CPU:-1} BENCH_MODE=serve \
+    timeout 900 python bench.py | tee "$FRESH_DIR/serve_fresh.jsonl"
+fi
+
 if [ "${RUN_ELASTIC:-0}" = "1" ]; then
   # the elastic legs run the full crash->resume episodes, so give them
-  # their own timeout and a scratch record to gate
-  FRESH=$(mktemp -d)/elastic_fresh.jsonl
+  # their own timeout
   BENCH_CPU=${BENCH_CPU:-1} BENCH_CPU_DEVICES=${BENCH_CPU_DEVICES:-8} \
     BENCH_MODE=elastic BENCH_STEPS=${BENCH_STEPS:-20} \
     BENCH_WARMUP=${BENCH_WARMUP:-3} \
-    timeout 1800 python bench.py | tee "$FRESH"
-  CANDIDATE=$FRESH
+    timeout 1800 python bench.py | tee "$FRESH_DIR/elastic_fresh.jsonl"
 fi
 
 python tools/bench_diff.py "$R" "$CANDIDATE" \

@@ -19,10 +19,10 @@
 # bench mode appends one JSON line to its round's records file.
 # Usage: bash tools/tpu_followup.sh <round>   (requires the axon tunnel)
 set -u
-ROUND=${1:?usage: tpu_followup.sh <round: 4..18>}
+ROUND=${1:?usage: tpu_followup.sh <round: 4..19>}
 case "$ROUND" in (*[!0-9]*|'') echo "round must be a number, got '$ROUND'" >&2; exit 2;; esac
-if [ "$ROUND" -lt 4 ] || [ "$ROUND" -gt 18 ]; then
-  echo "unknown round $ROUND (expected 4..18)" >&2; exit 2
+if [ "$ROUND" -lt 4 ] || [ "$ROUND" -gt 19 ]; then
+  echo "unknown round $ROUND (expected 4..19)" >&2; exit 2
 fi
 cd "$(dirname "$0")/.."
 R=bench_records
@@ -325,6 +325,23 @@ legs_r18() {
   python tools/bench_diff.py "$R" "$R/elastic_tpu_r18.jsonl" --format github \
     > "$R/bench_diff_tpu_r18.md" 2>>"$ERR" \
     || echo "bench_diff flagged drift (see bench_diff_tpu_r18.md)" >&2
+}
+
+legs_r19() {
+  # serving engine: the BENCH_MODE=serve legs on real chips. The CPU
+  # record (serve_cpu_r19.jsonl) proves the batching win, the
+  # zero-recompile pin and interpret-mode kernel parity; chips are
+  # needed for (a) real tokens/sec/chip + TTFT under MXU decode steps,
+  # (b) the Mosaic-lowered gather kernel's parity + speed vs the xla
+  # gather (PAGED_IMPL=pallas — the record that would flip the default,
+  # per the FLASH_BWD/QUANT_IMPL convention), and (c) the int8 KV
+  # capacity ablation at hardware dequant cost.
+  run serve_xla    serve_tpu_r19.jsonl 1200 BENCH_MODE=serve
+  run serve_pallas serve_tpu_r19.jsonl 1200 BENCH_MODE=serve PAGED_IMPL=pallas
+  run serve_int8   serve_tpu_r19.jsonl 1200 BENCH_MODE=serve BENCH_KV_QUANT=int8
+  python tools/bench_diff.py "$R" "$R/serve_tpu_r19.jsonl" --format github \
+    > "$R/bench_diff_tpu_r19.md" 2>>"$ERR" \
+    || echo "bench_diff flagged drift (see bench_diff_tpu_r19.md)" >&2
 }
 
 # -- the historical chain ---------------------------------------------------
