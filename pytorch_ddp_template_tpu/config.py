@@ -155,6 +155,13 @@ class TrainingConfig:
     #                              conv outputs, recompute only norm/ReLU)
     fused_head: bool = False  # blockwise LM head (ops/lm_head.py): no
     #                           (B,T,V) logits; long-context LMs default on
+    num_layers: int = 0  # override the zoo entry's transformer depth
+    #                      (0 = entry default). The serving draft
+    #                      workflow: train a shallow twin of the target
+    #                      config (--num_layers d) and point
+    #                      ServeEngine.from_checkpoint(draft_dir=...) at
+    #                      it — same vocab/width, restored through the
+    #                      same layout converter (serve/spec.py)
     coordinator_address: str | None = None  # jax.distributed rendezvous
     num_processes: int | None = None
     process_id: int | None = None
@@ -357,6 +364,11 @@ class TrainingConfig:
             raise ValueError(
                 f"unknown --grad_comm {self.grad_comm!r}; expected "
                 "fp32 | bf16 | int8"
+            )
+        if self.num_layers < 0:
+            raise ValueError(
+                f"--num_layers must be >= 0 (0 = the zoo entry's "
+                f"default depth), got {self.num_layers}"
             )
         if self.ddp_overlap and self.fsdp:
             # mutually exclusive by construction: --ddp_overlap's reduce
@@ -755,6 +767,16 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "(ops/lm_head.py): the (B,T,V) logits tensor never "
                         "materialises. gpt-long/bert-long default it on; "
                         "this turns it on for the other LM families.")
+    p.add_argument("--num_layers", type=int, default=0,
+                   help="Override the zoo entry's transformer depth "
+                        "(0 = entry default; transformer families only). "
+                        "The speculative-serving draft workflow: train a "
+                        "shallow twin of the target config with "
+                        "--num_layers d, then serve with "
+                        "ServeEngine.from_checkpoint(draft_dir=...) — "
+                        "same vocab and width, depth is the only knob "
+                        "(serve/spec.py shares the target's embedding "
+                        "table at serving time).")
     p.add_argument("--quant_compute", type=str, default="off",
                    choices=["off", "int8", "fp8"],
                    help="Low-precision compute path (ops/quant.py): the "

@@ -47,6 +47,17 @@ def build(name: str, config: TrainingConfig, mesh=None) -> tuple[Task, Dataset]:
         task, ds = factory(config, mesh=mesh)
     else:
         task, ds = factory(config)
+    if config.num_layers:
+        # depth override (the --num_layers draft-training workflow):
+        # clone BEFORE the other knobs so remat/scan see the final depth
+        if not hasattr(task.model, "num_layers"):
+            raise ValueError(
+                f"--num_layers: model {name!r} "
+                f"({type(task.model).__name__}) has no transformer "
+                "layer-depth knob (transformer families only; the "
+                "pipelined entries own their stage stacking)"
+            )
+        task.model = task.model.clone(num_layers=config.num_layers)
     if config.remat:
         if not hasattr(task.model, "remat"):
             raise ValueError(

@@ -55,11 +55,14 @@ log = get_logger(__name__)
 #: r19 adds the serving buckets: ``serve_prefill`` (admission forwards —
 #: the TTFT cost) and ``serve_decode`` (per-token steps) — an engine
 #: hosting a serving loop meters it with the same ledger the train loop
-#: uses, so train-vs-serve wall split reads straight off goodput.json
+#: uses, so train-vs-serve wall split reads straight off goodput.json.
+#: r20 splits ``serve_draft`` out of decode: the speculative draft
+#: model's wall (prefill + proposal loop, ``serve/spec.py``) — the
+#: wager's cost side, so draft-spend vs verify-win reads off the ledger
 BUCKETS = ("productive_step", "compile", "checkpoint_save",
            "hot_checkpoint_save", "restore", "input_stall", "eval",
            "halted", "evict_resume", "serve_prefill", "serve_decode",
-           "other")
+           "serve_draft", "other")
 
 FILENAME = "goodput.json"
 

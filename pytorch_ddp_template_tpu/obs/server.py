@@ -102,6 +102,26 @@ def _gauge(lines: list[str], seen: set[str], name: str, value: Any,
     lines.append(f"{name}{label_s} {v!r}")
 
 
+#: HELP text for record-derived gauges whose meaning is not readable
+#: from the name alone — the speculative-serving rates especially: an
+#: alerting rule on acceptance collapse should not need the repo docs
+_RECORD_HELP = {
+    "serve_spec_accept_rate": "draft tokens accepted / drafted, lifetime "
+                              "(speculative decoding)",
+    "serve_spec_accept_rate_rolling": "EWMA acceptance over recent verify "
+                                      "rounds (the adaptive-k signal)",
+    "serve_spec_accepted_per_target_step": "tokens committed per (slot, "
+                                           "verify round) — the >1 "
+                                           "multiplier spec decoding buys",
+    "serve_spec_draft_s_total": "draft-model wall (prefill + proposal "
+                                "loop) — the wager's cost side",
+    "serve_spec_verify_s_total": "target verify wall (one batched "
+                                 "dispatch per round)",
+    "serve_spec_k_mean": "mean adaptive draft window over running "
+                         "requests",
+}
+
+
 def prometheus_lines(snapshot: dict[str, Any]) -> str:
     """Render a ``/status``-shaped snapshot as Prometheus text format.
 
@@ -124,7 +144,8 @@ def prometheus_lines(snapshot: dict[str, Any]) -> str:
         for k, v in rec.items():
             if isinstance(v, (list, tuple)) or k.endswith("_repr"):
                 continue  # vectors / repr strings: JSONL-only channels
-            _gauge(lines, seen, prom_name(k), v, {"host": host})
+            _gauge(lines, seen, prom_name(k), v, {"host": host},
+                   help_=_RECORD_HELP.get(k))
     gp = snapshot.get("goodput") or {}
     if gp.get("goodput") is not None:
         _gauge(lines, seen, prom_name("goodput_ratio"), gp["goodput"],

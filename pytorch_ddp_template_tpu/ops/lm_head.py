@@ -250,6 +250,33 @@ def greedy_decode(hidden, table, *, bias=None, block: int = 8192):
     return best_i
 
 
+#: token-sampling policies :func:`sample_tokens` serves. v1 is greedy
+#: only — the serving engine's lossless speculative-decode guarantee is
+#: stated (and pinned) against greedy argmax, and every policy added
+#: here must either preserve it or be refused by the spec path.
+SAMPLING_POLICIES = ("greedy",)
+
+
+def sample_tokens(hidden, table, *, policy: str = "greedy", bias=None,
+                  block: int = 8192):
+    """The serving engine's sampling seam over the online-argmax bundle.
+
+    One dispatcher between "final hidden states" and "next token ids",
+    so temperature/top-k/top-p can later ride the same blockwise pass
+    (a Gumbel-max fold is one more ``_argmax_step``-shaped reduction)
+    without touching the engine again. ``policy="greedy"`` is
+    BIT-IDENTICAL to :func:`greedy_decode` — the engine refactor onto
+    this seam is a pinned no-op. Unknown policies are refused here, at
+    trace time, with the supported list named.
+    """
+    if policy not in SAMPLING_POLICIES:
+        raise ValueError(
+            f"unknown sampling policy {policy!r}; v1 serves "
+            f"{SAMPLING_POLICIES} (temperature/top-k land as a blockwise "
+            "Gumbel-max fold on this same seam)")
+    return greedy_decode(hidden, table, bias=bias, block=block)
+
+
 # -- TP ring head (--tp_overlap): model-sharded vocab, rotating stats ------
 #
 # With the vocab table sharded over the ``model`` mesh axis (the

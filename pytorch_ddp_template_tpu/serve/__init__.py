@@ -9,8 +9,16 @@ extracted) samples without materialising logits, and
 ``CheckpointManager.restore_raw`` + the r18 reshard converter load a
 training checkpoint at ANY layer layout straight into the serving
 template. See ``serve/engine.py`` for the architecture note.
+
+r20 adds speculative decoding (``serve/spec.py``): a shallow
+shared-embedding draft proposes k tokens, the target verifies the
+window in one dispatch, greedy longest-prefix acceptance keeps the
+output token-for-token identical to plain greedy decode —
+``ServeConfig(spec_k=..., draft_depth=...)`` turns it on.
 """
 
 from .engine import ServeConfig, ServeEngine  # noqa: F401
 from .kv_cache import PagedKVCache  # noqa: F401
 from .scheduler import ContinuousScheduler, Request  # noqa: F401
+from .spec import (AdaptiveK, SpecRunner, adopt_draft_checkpoint,  # noqa: F401
+                   draft_seq_id, make_draft_params)

@@ -30,6 +30,17 @@ Params load through ``CheckpointManager.restore_raw`` + the r18
 layout converter (:meth:`ServeEngine.from_checkpoint`): a training
 checkpoint at ANY layer layout (scanned / unrolled / pipelined)
 restores into the serving template directly.
+
+``spec_k > 0`` (r20) swaps the decode phase for speculative decoding
+(``serve/spec.py``): a shallow shared-embedding draft proposes k
+tokens, the target verifies the window in ONE dispatch, and greedy
+longest-prefix acceptance keeps the output token-for-token identical
+to plain greedy decode.  The compile contract extends, it does not
+bend: exactly TWO compiled decode programs (draft + verify), admission
+reserves draft lanes too (worst case doubles), and the draft wall
+books to the ``serve_draft`` goodput bucket.  Sampling goes through
+the ``ops/lm_head.sample_tokens`` seam (``ServeConfig.sampling``,
+greedy-only v1) so future policies never touch the engine.
 """
 
 from __future__ import annotations
@@ -77,6 +88,18 @@ class ServeConfig:
     eos_id: int | None = None     # early-stop token (None = length-only)
     vocab_block: int = 8192       # greedy-decode vocab tile
     static_batch: bool = False    # ablation: wave admission (the baseline)
+    sampling: str = "greedy"      # ops/lm_head.sample_tokens policy seam
+    spec_k: int = 0               # speculative decoding: max draft window
+    #                               per round (0 = off; the verify
+    #                               program's fixed lane count is
+    #                               max_slots * spec_k)
+    draft_depth: int = 0          # sliced-draft depth (first N target
+    #                               layers); required when spec_k > 0
+    #                               unless an external draft checkpoint
+    #                               is passed
+    spec_adaptive: bool = True    # per-request adaptive-k controller
+    #                               (full accept grows the window,
+    #                               rejection shrinks to evidence)
 
     def buckets(self) -> tuple[int, ...]:
         bks = self.prefill_buckets or _default_buckets(
@@ -133,9 +156,23 @@ class ServeEngine:
     docstring for the step anatomy."""
 
     def __init__(self, model, params: dict, cfg: ServeConfig | None = None,
-                 *, mesh=None, goodput=None, status=None):
+                 *, mesh=None, goodput=None, status=None,
+                 draft_params: dict | None = None):
         self.cfg = cfg or ServeConfig()
         self._validate_model(model)
+        from ..ops.lm_head import SAMPLING_POLICIES
+
+        if self.cfg.sampling not in SAMPLING_POLICIES:
+            raise ValueError(
+                f"unknown sampling policy {self.cfg.sampling!r}; v1 "
+                f"serves {SAMPLING_POLICIES} (the ops/lm_head."
+                "sample_tokens seam is where new policies land)")
+        if self.cfg.spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {self.cfg.spec_k}")
+        if draft_params is not None and not self.cfg.spec_k:
+            raise ValueError(
+                "draft_params given but spec_k is 0: set spec_k > 0 to "
+                "turn speculative decoding on")
         self.model = model
         self.mesh = mesh
         self.dtype = model.dtype
@@ -204,6 +241,34 @@ class ServeEngine:
         self._status = status
         if status is not None:
             status.sources["serve"] = self.serve_state
+        # speculative decoding (serve/spec.py): build the draft AFTER
+        # placement so a sliced draft shares the placed target arrays
+        # by reference
+        self._spec = None
+        if self.cfg.spec_k:
+            from .spec import SpecRunner, adopt_draft_checkpoint, \
+                make_draft_params
+
+            if draft_params is not None:
+                draft, depth = adopt_draft_checkpoint(draft_params,
+                                                      self.params)
+                if self.cfg.draft_depth and self.cfg.draft_depth != depth:
+                    raise ValueError(
+                        f"draft checkpoint holds {depth} layers but "
+                        f"draft_depth asks for {self.cfg.draft_depth}; "
+                        "drop draft_depth (it is inferred from the "
+                        "checkpoint) or fix the checkpoint")
+            else:
+                draft = make_draft_params(self.params, self.cfg.draft_depth)
+                depth = self.cfg.draft_depth
+            if mesh is not None:
+                draft = place_for_serving(draft, mesh)
+            self._spec = SpecRunner(self, draft, depth)
+            log.info("speculative decoding on", {
+                "spec_k": self.cfg.spec_k, "draft_depth": depth,
+                "adaptive": self.cfg.spec_adaptive,
+                "draft_source": ("checkpoint" if draft_params is not None
+                                 else "sliced")})
         # donation lets XLA update the pool in place; CPU ignores it
         # with a warning per program, so gate on backend
         donate = (1,) if jax.default_backend() == "tpu" else ()
@@ -265,10 +330,11 @@ class ServeEngine:
                 k.astype(pool["k"].dtype))
             pool["v"] = pool["v"].at[:, block_ids].set(
                 v.astype(pool["v"].dtype))
-        from ..ops.lm_head import greedy_decode
+        from ..ops.lm_head import sample_tokens
 
         h_last = jnp.take(hidden[0], length - 1, axis=0)  # (E,)
-        nxt = greedy_decode(h_last[None], params["wte"]["embedding"],
+        nxt = sample_tokens(h_last[None], params["wte"]["embedding"],
+                            policy=self.cfg.sampling,
                             block=self.cfg.vocab_block)[0]
         return nxt, pool
 
@@ -278,9 +344,10 @@ class ServeEngine:
             params, pool, tokens, positions, tables, ctx_lens,
             write_blocks, write_offsets, dtype=self.dtype,
             kv_quant=self.cfg.kv_quant)
-        from ..ops.lm_head import greedy_decode
+        from ..ops.lm_head import sample_tokens
 
-        nxt = greedy_decode(hidden, params["wte"]["embedding"],
+        nxt = sample_tokens(hidden, params["wte"]["embedding"],
+                            policy=self.cfg.sampling,
                             block=self.cfg.vocab_block)
         return nxt, pool
 
@@ -297,22 +364,33 @@ class ServeEngine:
             raise ValueError(
                 f"prompt {len(prompt)} + max_new_tokens {max_new_tokens} "
                 f"exceeds max_model_len {self.cfg.max_model_len}")
-        need = self.kv.blocks_needed(len(prompt) + max_new_tokens)
+        need = self._blocks_reserved(len(prompt), max_new_tokens)
         if need > self.kv.num_blocks - 1:
             # refuse at submit: an unadmittable request would sit at the
             # queue head forever (FCFS) starving everything behind it
             raise ValueError(
                 f"request needs {need} KV blocks but the pool holds "
                 f"{self.kv.num_blocks - 1}; raise num_blocks or lower "
-                "max_new_tokens")
+                "max_new_tokens"
+                + (" (speculative decoding doubles the reservation: "
+                   "the draft twin mirrors the target's lanes)"
+                   if self._spec is not None else ""))
         return self.scheduler.submit(prompt, max_new_tokens)
+
+    def _blocks_reserved(self, prompt_len: int, max_new: int) -> int:
+        """Worst-case blocks one request commits.  Spec mode doubles
+        it: the draft twin writes the SAME position range (k clamps to
+        the remaining budget, so neither sequence ever exceeds
+        ``prompt + max_new`` positions)."""
+        need = self.kv.blocks_needed(prompt_len + max_new)
+        return 2 * need if self._spec is not None else need
 
     def _can_admit(self, req: Request) -> bool:
         """Admission = reservation: the worst-case block count is
         committed HERE, not at prefill — the scheduler approves a whole
         wave before any prefill runs, and each member must see the
         members admitted before it (the no-OOM invariant)."""
-        need = self.kv.blocks_needed(len(req.prompt) + req.max_new_tokens)
+        need = self._blocks_reserved(len(req.prompt), req.max_new_tokens)
         budget = self.kv.num_blocks - 1  # null block excluded
         if sum(self._committed.values()) + need > budget:
             return False
@@ -324,23 +402,35 @@ class ServeEngine:
         """One iteration of the serving loop: admit (+prefill), decode,
         evict finished. Returns the flat stats record it published."""
         admitted = self.scheduler.admit(self._can_admit)
+        spec_d0 = self._spec.draft_s if self._spec is not None else 0.0
         t0 = time.perf_counter()
         for req in admitted:
             self._prefill_request(req)
         prefill_dt = time.perf_counter() - t0 if admitted else 0.0
+        spec_d1 = self._spec.draft_s if self._spec is not None else 0.0
+        prefill_dt = max(0.0, prefill_dt - (spec_d1 - spec_d0))
         self._prefill_s += prefill_dt
         t1 = time.perf_counter()
         decode_dt = 0.0
         if self.scheduler.running:
-            self._decode_step()
+            if self._spec is not None:
+                self._spec.decode_step(dict(self.scheduler.running))
+            else:
+                self._decode_step()
             decode_dt = time.perf_counter() - t1
-            self._decode_s += decode_dt
+        spec_d2 = self._spec.draft_s if self._spec is not None else 0.0
+        decode_dt = max(0.0, decode_dt - (spec_d2 - spec_d1))
+        self._decode_s += decode_dt
+        draft_dt = spec_d2 - spec_d0
         self.steps += 1
         if self._goodput is not None:
             if prefill_dt:
                 self._goodput.add("serve_prefill", prefill_dt)
             if decode_dt:
                 self._goodput.add("serve_decode", decode_dt)
+            if draft_dt:
+                # the speculative wager's cost side, metered apart
+                self._goodput.add("serve_draft", draft_dt)
         if self._status is None:
             return {}  # no sink: don't assemble gauges in the token path
         rec = self.stats()
@@ -365,6 +455,11 @@ class ServeEngine:
         req.t_first_token = time.time()
         self.tokens_out += 1
         self._maybe_finish(req, tok)
+        if self._spec is not None and req.state != "finished":
+            # draft twin prefills AFTER the first token is out (TTFT
+            # stays the target's prefill alone); skipped when the first
+            # token already finished the request
+            self._spec.prefill(req)
 
     def _decode_step(self) -> None:
         s = self.cfg.max_slots
@@ -401,6 +496,8 @@ class ServeEngine:
         if done:
             self.scheduler.finish(req)
             self.kv.free(req.id)
+            if self._spec is not None:
+                self._spec.release(req)
             self._committed.pop(req.id, None)
 
     def run(self, max_steps: int = 100_000) -> dict[int, list[int]]:
@@ -414,12 +511,20 @@ class ServeEngine:
 
     # -- reporting ---------------------------------------------------------
     def decode_programs(self) -> int:
-        """Compiled decode-program count — the zero-recompile pin
-        (must stay 1 however sequences grow)."""
-        return self._decode_fn._cache_size()
+        """Compiled decode-program count — the zero-recompile pin:
+        1 plain, 2 speculative (draft + verify; the plain decode
+        program never traces in spec mode), however sequences grow or
+        k adapts."""
+        n = self._decode_fn._cache_size()
+        if self._spec is not None:
+            n += self._spec.decode_program_count()
+        return n
 
     def prefill_programs(self) -> int:
-        return self._prefill_fn._cache_size()
+        n = self._prefill_fn._cache_size()
+        if self._spec is not None:
+            n += self._spec.prefill_program_count()
+        return n
 
     def stats(self) -> dict[str, Any]:
         """Flat SLO/capacity gauges, ``serve_``-prefixed — the record
@@ -453,6 +558,8 @@ class ServeEngine:
             rec["serve_ttft_ms_max"] = slo["ttft_s_max"] * 1e3
         if slo["per_token_s_mean"] is not None:
             rec["serve_per_token_ms_mean"] = slo["per_token_s_mean"] * 1e3
+        if self._spec is not None:
+            rec.update(self._spec.stats_fields(self.scheduler.running))
         return rec
 
     def serve_state(self) -> dict[str, Any]:
@@ -464,17 +571,8 @@ class ServeEngine:
         }
 
     # -- the checkpoint seam -----------------------------------------------
-    @classmethod
-    def from_checkpoint(cls, directory, model,
-                        cfg: ServeConfig | None = None, *, step=None,
-                        mesh=None, goodput=None, status=None
-                        ) -> "ServeEngine":
-        """Serve a TRAINING checkpoint directly: template-free read
-        (``restore_raw`` — falls back past torn steps), the r18 layout
-        converter restacks scanned/unrolled/pipelined into the serving
-        template, and the params place onto ``mesh``. The optimizer
-        state rides along in the raw read and is dropped here — serving
-        wants the params leaf only."""
+    @staticmethod
+    def _restore_params(directory, step):
         from ..checkpoint.manager import CheckpointManager
 
         mngr = CheckpointManager(directory)
@@ -487,7 +585,35 @@ class ServeEngine:
             raise ValueError(
                 f"checkpoint at {directory} holds no 'params' item — "
                 "not a training-state checkpoint this engine can serve")
+        return step_n, params
+
+    @classmethod
+    def from_checkpoint(cls, directory, model,
+                        cfg: ServeConfig | None = None, *, step=None,
+                        draft_dir=None, draft_step=None,
+                        mesh=None, goodput=None, status=None
+                        ) -> "ServeEngine":
+        """Serve a TRAINING checkpoint directly: template-free read
+        (``restore_raw`` — falls back past torn steps), the r18 layout
+        converter restacks scanned/unrolled/pipelined into the serving
+        template, and the params place onto ``mesh``. The optimizer
+        state rides along in the raw read and is dropped here — serving
+        wants the params leaf only.
+
+        ``draft_dir`` (with ``cfg.spec_k > 0``) loads an independently
+        trained shallow draft through the SAME seam — the
+        ``--num_layers`` workflow: train a depth-d twin of the target
+        config, point draft_dir at its checkpoints, and the engine
+        adopts its stack while sharing the target's embedding table
+        (see ``serve/spec.py``)."""
+        step_n, params = cls._restore_params(directory, step)
         log.info("serving checkpoint", {"dir": str(directory),
                                         "step": step_n})
+        draft_params = None
+        if draft_dir is not None:
+            d_step, draft_params = cls._restore_params(draft_dir,
+                                                       draft_step)
+            log.info("draft checkpoint", {"dir": str(draft_dir),
+                                          "step": d_step})
         return cls(model, params, cfg, mesh=mesh, goodput=goodput,
-                   status=status)
+                   status=status, draft_params=draft_params)

@@ -181,6 +181,31 @@ class PagedKVCache:
         self._lens[seq_id] = pos + 1
         return self._tables[seq_id][blk_idx], off
 
+    def truncate(self, seq_id: int, n_tokens: int) -> int:
+        """Roll ``seq_id`` back to ``n_tokens``: blocks past
+        ``ceil(n/block_size)`` return to the free list (LIFO, like
+        :meth:`free`) and the logical length clamps. The speculative-
+        decode rejection path — a rejected draft tail is popped here,
+        never copied or recompiled. Returns blocks released. Growing
+        through truncate is refused (that is :meth:`append_slot`'s
+        job)."""
+        if seq_id not in self._tables:
+            raise KeyError(f"seq {seq_id} holds no allocation")
+        if n_tokens > self._lens[seq_id]:
+            raise ValueError(
+                f"truncate(seq {seq_id}, {n_tokens}) would GROW the "
+                f"sequence (length {self._lens[seq_id]}); use "
+                "append_slot to extend")
+        keep = self.blocks_needed(n_tokens)
+        blocks = self._tables[seq_id]
+        released = 0
+        while len(blocks) > keep:
+            self._free.append(blocks.pop())
+            released += 1
+        self.free_count += released
+        self._lens[seq_id] = n_tokens
+        return released
+
     def free(self, seq_id: int) -> int:
         """Return ``seq_id``'s blocks to the pool; count released."""
         blocks = self._tables.pop(seq_id, None)

@@ -180,3 +180,46 @@ def decode_forward(params: dict, pool: dict, token_ids: jax.Array,
     x, pool = lax.scan(body, x, (stacked_layers(params), pool))
     hidden = layer_norm(x, params["final_ln"]).astype(dtype)
     return hidden, pool
+
+
+def verify_forward(params: dict, pool: dict, token_ids: jax.Array,
+                   positions: jax.Array, tables: jax.Array,
+                   context_lens: jax.Array, write_blocks: jax.Array,
+                   write_offsets: jax.Array, *, dtype,
+                   kv_quant: str = "off"):
+    """Score a k-token draft window for every slot in ONE step — the
+    speculative-decode batch-verify path.
+
+    Each slot's window of ``k`` consecutive draft positions flattens
+    into ``k`` independent decode lanes sharing that slot's block
+    table, with STAGGERED context lengths (lane ``j`` sees positions
+    ``< positions[s, j] + 1``): inside :func:`decode_forward`'s scan
+    every layer writes the whole window's KV before its paged-attention
+    gather, so lane ``j`` attends to lanes ``< j`` of the same window —
+    intra-window causality without a new kernel, and the target scores
+    all ``k`` draft positions in one compiled program.
+
+    Window tails past a slot's live draft length (``k`` rarely fills
+    the fixed verify bucket) follow the bucketed-prefill scrap
+    convention: ``context_len 0``, null-block write target — the lane
+    computes garbage the mask never reads and the scatter dumps into
+    block 0's scrap space (unit-pinned).
+
+    Args:
+      token_ids, positions, context_lens, write_blocks, write_offsets:
+        ``(S, K)`` per-slot windows.
+      tables: ``(S, K, max_blocks)`` — the slot's table replicated per
+        lane (extra trailing blocks are masked by the lane's context).
+
+    Returns ``(hidden (S, K, E), pool)``.
+    """
+    s, k = token_ids.shape
+
+    def flat(a):
+        return a.reshape((s * k,) + a.shape[2:])
+
+    hidden, pool = decode_forward(
+        params, pool, flat(token_ids), flat(positions), flat(tables),
+        flat(context_lens), flat(write_blocks), flat(write_offsets),
+        dtype=dtype, kv_quant=kv_quant)
+    return hidden.reshape(s, k, -1), pool

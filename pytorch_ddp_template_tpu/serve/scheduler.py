@@ -18,6 +18,16 @@ That is deliberately conservative next to vLLM's optimistic
 admission + preempt-on-OOM — preemption needs KV swap/recompute
 machinery this engine doesn't carry yet; the committed-blocks ledger
 makes the no-OOM guarantee a one-line invariant instead.
+
+A decode step may emit SEVERAL tokens per request at once (the
+speculative verify step commits an accepted run, ``serve/spec.py``):
+``Request.tokens`` grows by the whole run, so the SLO math needs no
+special case — ``per_token_s`` divides the decode wall by tokens
+actually emitted, TTFT is still the prefill's single first token, and
+admission already reserved the draft twin's lanes through the engine's
+``can_admit`` callback.  Continuous join/evict is untouched: a
+finished member leaves at the round it finishes, whatever the round's
+emission width.
 """
 
 from __future__ import annotations
@@ -43,6 +53,11 @@ class Request:
     t_submit: float = 0.0
     t_first_token: float | None = None
     t_finished: float | None = None
+    # -- speculative decoding (serve/spec.py): the per-request
+    #    controller state rides the request so it joins/evicts with it
+    draft_k: int = 0       # current adaptive draft window (0 = unset)
+    spec_drafted: int = 0  # lifetime draft tokens proposed for this req
+    spec_accepted: int = 0  # lifetime draft tokens accepted
 
     @property
     def ttft_s(self) -> float | None:

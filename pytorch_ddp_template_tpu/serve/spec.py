@@ -1,0 +1,466 @@
+"""Speculative decoding: a shallow draft model proposes k tokens, the
+target scores the whole window in ONE step (Leviathan et al.).
+
+Decode is memory-bound — each target step reads every weight to emit
+one token per slot.  Speculative decoding spends a shallow draft's
+FLOPs to turn k sequential target steps into one batched verification:
+the draft autoregressively proposes ``d_1..d_k``; the target then
+scores the window ``[t_last, d_1..d_{k-1}]`` as k staggered decode
+lanes in a single compiled program (:func:`serve.model.verify_forward`)
+and greedy longest-prefix acceptance keeps the longest draft prefix
+matching the target argmax plus ONE free correction token.
+
+**Lossless by construction.** Let ``m`` be the longest prefix with
+``d_i == y_i`` where ``y_i`` is the target argmax after consuming the
+window input at position ``n+i-1``.  The round commits
+``d_1..d_m + y_{m+1}`` (or ``d_1..d_k`` on full acceptance) — every
+committed token is, by induction, exactly the token target-only greedy
+decode would have produced from the same context, so speculative
+output is token-for-token identical to the baseline (pinned as an
+engine-level equality test, ``tests/test_spec.py``).
+
+**KV lockstep + free-list rollback.** Draft and target write the SAME
+positions ``n..n+k-1`` each round (the draft through its own lanes in
+the shared paged pool — distinct ``seq_id``s via :func:`draft_seq_id`,
+occupying layers ``0..depth-1`` of draft-owned blocks; layers past the
+draft's depth in those blocks are idle, the documented cost of sharing
+one pool).  Rejection truncates BOTH sequences to ``n + min(m+1, k)``
+— :meth:`serve.kv_cache.PagedKVCache.truncate`, a free-list pop, never
+a copy or a recompile.
+
+**Compile-count contract.** Exactly two compiled decode programs ever:
+the draft step (fixed ``(max_slots,)`` lanes over the depth-sliced
+pool) and the verify step (fixed ``(max_slots, spec_k)`` window —
+short rounds pad into null-block scrap lanes exactly like bucketed
+prefill).  Extends the r19 zero-recompile pin;
+``ServeEngine.decode_programs()`` must report 2 in spec mode, however
+sequences grow or k adapts.
+
+**The draft.** Default: the target's first ``draft_depth`` scanned
+layers plus its embedding table, positional table and final LayerNorm,
+shared BY REFERENCE (no extra copies resident; the tied LM head is the
+same shared table).  Or an independently trained shallow checkpoint
+(``--num_layers`` makes training one a one-flag job) restored through
+the same ``convert_tree_layout`` seam — its decoder stack and final
+LayerNorm serve, the embedding/positional tables and tied head still
+come from the target (train the draft against the target's frozen
+embeddings for best acceptance; acceptance only affects SPEED, never
+output).
+
+**Adaptive k** (:class:`AdaptiveK`): per-request TCP-style control —
+full acceptance grows the next window by one (up to ``spec_k``), a
+rejection shrinks it to what the round proved (``accepted + 1``), and
+a rolling EWMA acceptance rate feeds the ``tpuddp_serve_spec_*``
+gauges.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import get_logger
+from .kv_cache import NULL_BLOCK, quantize_kv
+from .model import decode_forward, prefill_forward, stacked_layers, \
+    verify_forward
+from .scheduler import Request
+
+log = get_logger(__name__)
+
+
+def draft_seq_id(request_id: int) -> int:
+    """The draft twin's allocator key: request ids are non-negative, so
+    the negative mirror never collides."""
+    return -request_id - 1
+
+
+def _stack_depth(layers: dict) -> int:
+    return jax.tree_util.tree_leaves(layers)[0].shape[0]
+
+
+def make_draft_params(target_params: dict, depth: int) -> dict:
+    """The default draft: the target's first ``depth`` scanned layers.
+
+    Embedding table, positional table and final LayerNorm are shared BY
+    REFERENCE (the same arrays — zero extra HBM beyond the sliced
+    stack); truncated-depth transformers keep a usable next-token
+    distribution because the residual stream feeds the tied head at
+    every depth.  Acceptance rate is the draft's only quality metric —
+    output is lossless regardless.
+    """
+    layers = stacked_layers(target_params)
+    n = _stack_depth(layers)
+    if not 1 <= depth <= n:
+        raise ValueError(
+            f"draft_depth {depth} out of range: the sliced draft takes "
+            f"1..{n} of the target's layers (draft_depth == num_layers "
+            "is the always-accept degenerate draft — valid, but all "
+            "FLOPs and no win)")
+    sliced = jax.tree_util.tree_map(lambda x: x[:depth], layers)
+    return {"wte": target_params["wte"], "wpe": target_params["wpe"],
+            "decoder": {"layers": sliced},
+            "final_ln": target_params["final_ln"]}
+
+
+def adopt_draft_checkpoint(raw_params: dict, target_params: dict
+                           ) -> tuple[dict, int]:
+    """An independently trained shallow draft, through the SAME seam a
+    target checkpoint loads by: unbox, ``convert_tree_layout`` to the
+    scanned template, validate geometry.  Its decoder stack and final
+    LayerNorm serve; the embedding/positional tables (and therefore the
+    tied head) are the TARGET's — one table resident, and the
+    ``--num_layers`` draft-training workflow is told to train against
+    frozen target embeddings for acceptance.  Returns
+    ``(draft_params, depth)`` with depth inferred from the stack."""
+    import flax.linen as nn
+
+    from ..parallel.stacking import convert_tree_layout
+
+    p = nn.meta.unbox(raw_params)
+    p = convert_tree_layout(p, "scanned", strict=False)
+    layers = stacked_layers(p)
+    depth = _stack_depth(layers)
+    target_depth = _stack_depth(stacked_layers(target_params))
+    if depth > target_depth:
+        raise ValueError(
+            f"draft checkpoint is DEEPER than the target ({depth} > "
+            f"{target_depth} layers): the draft shares the target's "
+            "paged pool and can only occupy a layer-prefix of it")
+    e_t = target_params["wte"]["embedding"].shape[-1]
+    e_d = layers["ln_attn"]["scale"].shape[-1]
+    if e_d != e_t:
+        raise ValueError(
+            f"draft embed width {e_d} != target {e_t}: the draft reads "
+            "the target's shared embedding table — train it at the "
+            "target's width (--num_layers changes depth only)")
+    draft = {"wte": target_params["wte"], "wpe": target_params["wpe"],
+             "decoder": {"layers": layers}, "final_ln": p["final_ln"]}
+    return draft, depth
+
+
+class AdaptiveK:
+    """Per-request draft-window controller + rolling acceptance.
+
+    TCP-shaped and deterministic (unit-tested as pure bookkeeping):
+    full acceptance grows the request's next window by 1 up to
+    ``k_max``; any rejection shrinks it to ``accepted + 1`` — the
+    length the round just proved profitable.  State lives ON the
+    :class:`~.scheduler.Request` (``draft_k``/``spec_drafted``/
+    ``spec_accepted``), so it joins and evicts with the request;
+    the controller itself holds only the global EWMA.
+    """
+
+    def __init__(self, k_max: int, *, enabled: bool = True,
+                 ema: float = 0.3):
+        if k_max < 1:
+            raise ValueError(f"k_max must be >= 1, got {k_max}")
+        self.k_max = k_max
+        self.enabled = enabled
+        self.ema = ema
+        self.accept_rate = 1.0  # rolling EWMA of accepted/drafted
+        self._rounds = 0
+
+    def k_for(self, req: Request) -> int:
+        """The window to draft for this request's next round."""
+        if not self.enabled:
+            return self.k_max
+        if req.draft_k < 1:
+            req.draft_k = self.k_max  # start optimistic; one bad round
+            #                           shrinks it to evidence
+        return req.draft_k
+
+    def update(self, req: Request, *, drafted: int, accepted: int) -> None:
+        req.spec_drafted += drafted
+        req.spec_accepted += accepted
+        rate = accepted / drafted if drafted else 0.0
+        if self._rounds == 0:
+            self.accept_rate = rate
+        else:
+            self.accept_rate = (self.ema * rate
+                                + (1.0 - self.ema) * self.accept_rate)
+        self._rounds += 1
+        if not self.enabled:
+            return
+        if accepted >= drafted:
+            req.draft_k = min(req.draft_k + 1, self.k_max)
+        else:
+            req.draft_k = max(1, accepted + 1)
+
+
+class SpecRunner:
+    """The engine's speculative-decode path: draft loop → one verify
+    dispatch → longest-prefix accept → symmetric KV rollback.
+
+    Owns the two spec-mode compiled decode programs (draft step,
+    verify step) and the draft's bucketed prefill; the engine delegates
+    its decode phase here when ``ServeConfig.spec_k > 0`` and keeps
+    everything else (admission, scheduling, eviction, checkpoints).
+    """
+
+    def __init__(self, engine, draft_params: dict, depth: int):
+        self.engine = engine
+        self.depth = depth
+        self.draft_params = draft_params
+        cfg = engine.cfg
+        self.ctrl = AdaptiveK(cfg.spec_k, enabled=cfg.spec_adaptive)
+        donate = (1,) if jax.default_backend() == "tpu" else ()
+        self._draft_prefill_fn = jax.jit(self._draft_prefill_math,
+                                         donate_argnums=donate)
+        self._draft_decode_fn = jax.jit(self._draft_decode_math,
+                                        donate_argnums=donate)
+        self._verify_fn = jax.jit(self._verify_math, donate_argnums=donate)
+        # the acceptance ledger (stats()/gauges read these)
+        self.draft_s = 0.0       # draft wall (prefill + decode loop)
+        self.verify_s = 0.0      # verify dispatch + acceptance sync
+        self.draft_steps = 0     # draft decode dispatches
+        self.verify_steps = 0    # verify dispatches
+        self.slot_rounds = 0     # (active slot, round) pairs
+        self.drafted_total = 0   # draft tokens proposed
+        self.accepted_total = 0  # draft tokens accepted
+        self.committed_total = 0  # tokens emitted through verify rounds
+
+    # -- jitted math -------------------------------------------------------
+    def _sub_pool(self, pool: dict) -> dict:
+        """The draft's view: layers ``0..depth-1`` of every pool leaf
+        (matches the scan length of its stacked params)."""
+        return {k: v[: self.depth] for k, v in pool.items()}
+
+    def _merge_pool(self, pool: dict, sub: dict) -> dict:
+        return {k: pool[k].at[: self.depth].set(sub[k]) for k in pool}
+
+    def _draft_prefill_math(self, params, pool, ids, block_ids):
+        """Insert the prompt's DRAFT KV (depth-sliced layer prefix of
+        the shared pool); the draft's prefill output is discarded — the
+        first token is the target prefill's, for losslessness."""
+        eng = self.engine
+        _, k, v = prefill_forward(params, ids, dtype=eng.dtype,
+                                  attn_impl=eng.attn_impl)
+        lyr, _, t, h, d = k.shape
+        nb = t // eng.cfg.block_size
+        k = k.reshape(lyr, nb, eng.cfg.block_size, h, d)
+        v = v.reshape(lyr, nb, eng.cfg.block_size, h, d)
+        pool = dict(pool)
+        if eng.cfg.kv_quant == "int8":
+            kq, ks = quantize_kv(k)
+            vq, vs = quantize_kv(v)
+            pool["k"] = pool["k"].at[: self.depth, block_ids].set(kq)
+            pool["v"] = pool["v"].at[: self.depth, block_ids].set(vq)
+            pool["k_scale"] = pool["k_scale"].at[
+                : self.depth, block_ids].set(ks)
+            pool["v_scale"] = pool["v_scale"].at[
+                : self.depth, block_ids].set(vs)
+        else:
+            pool["k"] = pool["k"].at[: self.depth, block_ids].set(
+                k.astype(pool["k"].dtype))
+            pool["v"] = pool["v"].at[: self.depth, block_ids].set(
+                v.astype(pool["v"].dtype))
+        return pool
+
+    def _draft_decode_math(self, params, pool, tokens, positions, tables,
+                           ctx_lens, write_blocks, write_offsets):
+        from ..ops.lm_head import sample_tokens
+
+        eng = self.engine
+        sub = self._sub_pool(pool)
+        hidden, sub = decode_forward(
+            params, sub, tokens, positions, tables, ctx_lens,
+            write_blocks, write_offsets, dtype=eng.dtype,
+            kv_quant=eng.cfg.kv_quant)
+        nxt = sample_tokens(hidden, params["wte"]["embedding"],
+                            policy=eng.cfg.sampling,
+                            block=eng.cfg.vocab_block)
+        return nxt, self._merge_pool(pool, sub)
+
+    def _verify_math(self, params, pool, tokens, positions, tables,
+                     ctx_lens, write_blocks, write_offsets):
+        from ..ops.lm_head import sample_tokens
+
+        eng = self.engine
+        hidden, pool = verify_forward(
+            params, pool, tokens, positions, tables, ctx_lens,
+            write_blocks, write_offsets, dtype=eng.dtype,
+            kv_quant=eng.cfg.kv_quant)
+        y = sample_tokens(hidden, params["wte"]["embedding"],
+                          policy=eng.cfg.sampling,
+                          block=eng.cfg.vocab_block)
+        return y, pool
+
+    # -- per-request lifecycle ---------------------------------------------
+    def prefill(self, req: Request) -> None:
+        """Prefill the prompt into the DRAFT's paged lanes (same bucket,
+        same null-block scrap convention as the target's prefill)."""
+        eng = self.engine
+        t0 = time.perf_counter()
+        plen = len(req.prompt)
+        did = draft_seq_id(req.id)
+        eng.kv.alloc(did, plen)
+        bucket = next(b for b in eng._buckets if b >= plen)
+        nb_bucket = bucket // eng.cfg.block_size
+        blocks = eng.kv.table(did)
+        block_ids = np.full((nb_bucket,), NULL_BLOCK, np.int32)
+        block_ids[: len(blocks)] = blocks
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :plen] = req.prompt
+        eng.kv.pool = self._draft_prefill_fn(
+            self.draft_params, eng.kv.pool, jnp.asarray(ids),
+            jnp.asarray(block_ids))
+        self.draft_s += time.perf_counter() - t0
+
+    def release(self, req: Request) -> None:
+        """Return the draft twin's blocks (no-op if never prefilled —
+        e.g. the request finished at its own prefill)."""
+        self.engine.kv.free(draft_seq_id(req.id))
+
+    # -- the spec decode round ---------------------------------------------
+    def decode_step(self, running: dict[int, Request]) -> None:
+        """One speculative round for every running slot: k draft
+        dispatches (device-resident token chain, no host sync), ONE
+        verify dispatch, one host sync for acceptance, symmetric
+        truncate of both KV sequences to the accepted length."""
+        eng = self.engine
+        cfg = eng.cfg
+        s_lanes = cfg.max_slots
+        k_cap = cfg.spec_k
+        m_blocks = eng.max_blocks
+
+        plan: dict[int, tuple[Request, int]] = {}
+        base_len: dict[int, int] = {}
+        feed = np.zeros((s_lanes,), np.int32)
+        for slot, req in running.items():
+            remaining = req.max_new_tokens - len(req.tokens)
+            k_i = max(1, min(self.ctrl.k_for(req), remaining))
+            plan[slot] = (req, k_i)
+            base_len[slot] = eng.kv.seq_len(req.id)
+            feed[slot] = req.tokens[-1]
+        k_round = max(k_i for _, k_i in plan.values())
+
+        # -- draft: k_round dispatches, token chain stays on device
+        t0 = time.perf_counter()
+        cur = jnp.asarray(feed)
+        drafts = []
+        for t in range(k_round):
+            positions = np.zeros((s_lanes,), np.int32)
+            ctx = np.zeros((s_lanes,), np.int32)
+            wb = np.full((s_lanes,), NULL_BLOCK, np.int32)
+            wo = np.zeros((s_lanes,), np.int32)
+            tables = np.full((s_lanes, m_blocks), NULL_BLOCK, np.int32)
+            for slot, (req, k_i) in plan.items():
+                if t >= k_i:
+                    continue  # this slot's window is shorter: its lane
+                    #           degrades to a ctx-0 null-block scrap lane
+                did = draft_seq_id(req.id)
+                pos = eng.kv.seq_len(did)
+                blk, off = eng.kv.append_slot(did)
+                positions[slot] = pos
+                ctx[slot] = pos + 1
+                wb[slot], wo[slot] = blk, off
+                tables[slot] = eng.kv.padded_table(did, m_blocks)
+            cur, eng.kv.pool = self._draft_decode_fn(
+                self.draft_params, eng.kv.pool, cur,
+                jnp.asarray(positions), jnp.asarray(tables),
+                jnp.asarray(ctx), jnp.asarray(wb), jnp.asarray(wo))
+            drafts.append(cur)
+            self.draft_steps += 1
+        draft_stack = jnp.stack(drafts, axis=1)  # (S, k_round): d_1..d_k
+        jax.block_until_ready(draft_stack)  # honest draft/verify split
+        self.draft_s += time.perf_counter() - t0
+
+        # -- verify: the whole window in ONE target dispatch
+        t1 = time.perf_counter()
+        positions = np.zeros((s_lanes, k_cap), np.int32)
+        ctx = np.zeros((s_lanes, k_cap), np.int32)
+        wb = np.full((s_lanes, k_cap), NULL_BLOCK, np.int32)
+        wo = np.zeros((s_lanes, k_cap), np.int32)
+        tables = np.full((s_lanes, k_cap, m_blocks), NULL_BLOCK, np.int32)
+        for slot, (req, k_i) in plan.items():
+            for j in range(k_i):
+                pos = eng.kv.seq_len(req.id)
+                blk, off = eng.kv.append_slot(req.id)
+                positions[slot, j] = pos
+                ctx[slot, j] = pos + 1  # lane j attends to lanes < j of
+                #                         its own window (write-then-
+                #                         gather inside the layer scan)
+                wb[slot, j], wo[slot, j] = blk, off
+            # one table snapshot AFTER the window's appends covers every
+            # lane: trailing blocks a short lane hasn't reached are
+            # masked by its context length
+            tables[slot, :k_i] = eng.kv.padded_table(req.id, m_blocks)
+        # window inputs [t_last, d_1..d_{k-1}]; the tail past k_round+1
+        # pads with null-lane zeros
+        window = jnp.concatenate([jnp.asarray(feed)[:, None], draft_stack],
+                                 axis=1)
+        if window.shape[1] < k_cap:
+            window = jnp.pad(window,
+                             ((0, 0), (0, k_cap - window.shape[1])))
+        y_dev, eng.kv.pool = self._verify_fn(
+            eng.params, eng.kv.pool, window[:, :k_cap],
+            jnp.asarray(positions), jnp.asarray(tables), jnp.asarray(ctx),
+            jnp.asarray(wb), jnp.asarray(wo))
+        y = np.asarray(y_dev)           # (S, k_cap): y[s, j] = y_{j+1}
+        d = np.asarray(draft_stack)     # (S, k_round): d[s, j] = d_{j+1}
+        self.verify_s += time.perf_counter() - t1
+        self.verify_steps += 1
+
+        # -- greedy longest-prefix acceptance + symmetric rollback
+        for slot, (req, k_i) in plan.items():
+            m = 0
+            while m < k_i and d[slot, m] == y[slot, m]:
+                m += 1
+            committed = [int(tok) for tok in d[slot, :m]]
+            if m < k_i:
+                committed.append(int(y[slot, m]))  # the free correction
+            new_len = base_len[slot] + min(m + 1, k_i)
+            eng.kv.truncate(req.id, new_len)
+            eng.kv.truncate(draft_seq_id(req.id), new_len)
+            self.ctrl.update(req, drafted=k_i, accepted=m)
+            self.drafted_total += k_i
+            self.accepted_total += m
+            self.slot_rounds += 1
+            for tok in committed:
+                req.tokens.append(tok)
+                eng.tokens_out += 1
+                self.committed_total += 1
+                eng._maybe_finish(req, tok)
+                if req.state == "finished":
+                    break  # eos mid-window: later tokens are discarded
+                    #        (exactly what the baseline never emits)
+
+    # -- reporting ---------------------------------------------------------
+    def decode_program_count(self) -> int:
+        """Spec mode's share of the zero-recompile pin: draft + verify
+        must each stay at ONE compiled program."""
+        return (self._draft_decode_fn._cache_size()
+                + self._verify_fn._cache_size())
+
+    def prefill_program_count(self) -> int:
+        return self._draft_prefill_fn._cache_size()
+
+    def stats_fields(self, running: dict[int, Request]) -> dict[str, Any]:
+        """``serve_spec_*`` gauges — ride the flat serve record onto
+        ``/status`` and ``/metrics`` untouched."""
+        k_live = [r.draft_k if r.draft_k >= 1 else self.ctrl.k_max
+                  for r in running.values()]
+        return {
+            "serve_spec_k_max": self.ctrl.k_max,
+            "serve_spec_draft_depth": self.depth,
+            "serve_spec_k_mean": (sum(k_live) / len(k_live)
+                                  if k_live else 0.0),
+            "serve_spec_accept_rate": (
+                self.accepted_total / self.drafted_total
+                if self.drafted_total else 0.0),
+            "serve_spec_accept_rate_rolling": self.ctrl.accept_rate,
+            "serve_spec_accepted_per_target_step": (
+                self.committed_total / self.slot_rounds
+                if self.slot_rounds else 0.0),
+            "serve_spec_drafted_total": self.drafted_total,
+            "serve_spec_accepted_total": self.accepted_total,
+            "serve_spec_committed_total": self.committed_total,
+            "serve_spec_draft_steps": self.draft_steps,
+            "serve_spec_verify_steps": self.verify_steps,
+            "serve_spec_draft_s_total": self.draft_s,
+            "serve_spec_verify_s_total": self.verify_s,
+        }

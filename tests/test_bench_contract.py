@@ -821,3 +821,31 @@ def test_quant_record_committed_and_affirmative():
     assert last["loss_dev_fp8"] < 0.05
     # the CPU record must say what it cannot prove: no narrow MXU here
     assert last["cpu_no_narrow_mxu"] is True
+
+
+@pytest.mark.slow
+def test_spec_mode_contract():
+    """BENCH_MODE=spec emits the headline record FIRST then one
+    ablation-marked row per draft depth, all on one invocation, with
+    the lossless re-check and the two-program pin carried as fields
+    (slow: four serving engines compiled in a subprocess; the committed
+    record in bench_records/ is this run's production twin)."""
+    code, lines, out = run_bench(
+        {"BENCH_MODE": "spec", "BENCH_SPEC_REQUESTS": "8",
+         "BENCH_SPEC_DEPTHS": "2"}, timeout=900)
+    assert code == 0, out[-2000:]
+    assert len(lines) == 2, out[-2000:]  # headline + one depth ablation
+    head, abl = lines
+    assert REQUIRED <= set(head)
+    assert head["metric"] == "serve_spec_accepted_per_target_step"
+    assert head["value"] > 1.0
+    assert head["spec_lossless_checked"] is True
+    assert head["decode_zero_recompile"] is True
+    assert head["decode_programs"] == 2
+    assert head["draft_programs"] == 1 and head["verify_programs"] == 1
+    assert head["spec_flops_per_token_ratio"] > 0
+    # the headline row must not carry the literal ablation keys ...
+    assert not any(head.get(k) for k in ("spec_k", "draft_depth"))
+    # ... and the ablation row MUST (bench_diff skips it as a headline)
+    assert abl["draft_depth"] == 2 and abl["spec_k"]
+    assert abl["spec_lossless_checked"] is True

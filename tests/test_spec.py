@@ -1,0 +1,645 @@
+"""Speculative decoding (r20): draft construction, the adaptive-k
+controller, KV truncate/rollback, the k-token batch-verify helper, and
+the engine-level acceptance anchors.
+
+The acceptance anchors: speculative greedy decode is token-for-token
+identical to the plain engine (mixed-length continuous batches, eos
+mid-window, int8 KV, external draft checkpoint), the compile cache
+holds exactly TWO decode programs in spec mode (draft + verify — the
+plain decode program never traces), and rollback leaves the paged
+allocator leak-free (alloc == free at drain).
+"""
+
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import flax.linen as nn
+
+from pytorch_ddp_template_tpu.models.gpt import GptDecoder, gpt_tiny
+from pytorch_ddp_template_tpu.parallel.stacking import restack_layer_trees
+from pytorch_ddp_template_tpu.serve import (
+    AdaptiveK, PagedKVCache, ServeConfig, ServeEngine, adopt_draft_checkpoint,
+    draft_seq_id, make_draft_params,
+)
+from pytorch_ddp_template_tpu.serve.kv_cache import NULL_BLOCK
+from pytorch_ddp_template_tpu.serve.scheduler import Request
+
+VOCAB = 256
+
+#: mixed-length continuous-batching workload: more requests than decode
+#: slots, staggered prompt and output lengths, so admission churns and
+#: slots re-fill mid-flight — the regime the lossless pin must hold in
+WORKLOAD = [
+    ([5, 6, 7], 20),
+    ([1, 2, 3, 4, 5, 6, 7, 8], 9),
+    ([9, 8, 7, 6], 15),
+    ([42], 12),
+    ([11, 12, 13, 14, 15, 16], 6),
+    ([200, 100, 50], 17),
+]
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    """(model, unboxed params, fused-head twin) — one init per module."""
+    model = gpt_tiny(vocab_size=VOCAB, seq_len=128)
+    params = nn.meta.unbox(model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32),
+        train=False)["params"])
+    fused = GptDecoder(vocab_size=VOCAB, max_len=128, num_layers=2,
+                       num_heads=2, head_dim=32, mlp_dim=128,
+                       fused_head=True)
+    return model, params, fused
+
+
+def ref_generate(fused, params, prompt, n):
+    """The unbatched reference loop: full forward per token, dense
+    logits, argmax — what the engine must reproduce token-for-token."""
+    toks = list(prompt)
+    out = []
+    for _ in range(n):
+        h = fused.apply({"params": params}, jnp.asarray([toks]),
+                        train=False)
+        logits = h[0, -1] @ params["wte"]["embedding"].T
+        tok = int(jnp.argmax(logits))
+        toks.append(tok)
+        out.append(tok)
+    return out
+
+
+def make_engine(model, params, **overrides):
+    cfg = dict(block_size=4, num_blocks=64, max_slots=3, max_model_len=64)
+    cfg.update(overrides)
+    return ServeEngine(model, params, ServeConfig(**cfg))
+
+
+def run_workload(eng, workload=WORKLOAD):
+    reqs = [eng.submit(p, max_new_tokens=n) for p, n in workload]
+    out = eng.run()
+    return [out[r.id] for r in reqs]
+
+
+# -- draft construction ----------------------------------------------------
+
+class TestDraftParams:
+    def test_sliced_draft_shares_by_reference(self, tiny):
+        _, params, _ = tiny
+        sp = restack_layer_trees(params)
+        draft = make_draft_params(sp, 1)
+        # zero-copy shares: the SAME arrays, not equal copies
+        assert draft["wte"] is sp["wte"]
+        assert draft["wpe"] is sp["wpe"]
+        assert draft["final_ln"] is sp["final_ln"]
+        stack = draft["decoder"]["layers"]
+        depth = jax.tree_util.tree_leaves(stack)[0].shape[0]
+        assert depth == 1
+        full = sp["decoder"]["layers"]
+        for d_leaf, f_leaf in zip(jax.tree_util.tree_leaves(stack),
+                                  jax.tree_util.tree_leaves(full)):
+            assert np.array_equal(np.asarray(d_leaf), np.asarray(f_leaf[:1]))
+
+    @pytest.mark.parametrize("depth", [0, 3, -1])
+    def test_depth_out_of_range_refused(self, tiny, depth):
+        _, params, _ = tiny
+        sp = restack_layer_trees(params)
+        with pytest.raises(ValueError, match="out of range"):
+            make_draft_params(sp, depth)
+
+    def test_adopt_checkpoint_infers_depth_and_shares_embeddings(self, tiny):
+        _, params, _ = tiny
+        sp = restack_layer_trees(params)
+        shallow = GptDecoder(vocab_size=VOCAB, max_len=128, num_layers=1,
+                             num_heads=2, head_dim=32, mlp_dim=128)
+        raw = shallow.init(jax.random.PRNGKey(3),
+                           jnp.zeros((1, 8), jnp.int32),
+                           train=False)["params"]
+        draft, depth = adopt_draft_checkpoint(raw, sp)
+        assert depth == 1
+        # embeddings are the TARGET's (shared table == tied head) ...
+        assert draft["wte"] is sp["wte"]
+        assert draft["wpe"] is sp["wpe"]
+        # ... the stack and final LayerNorm are the checkpoint's own
+        own = nn.meta.unbox(raw)
+        assert np.array_equal(
+            np.asarray(draft["final_ln"]["scale"]),
+            np.asarray(own["final_ln"]["scale"]))
+
+    def test_adopt_deeper_than_target_refused(self, tiny):
+        _, params, _ = tiny
+        shallow = GptDecoder(vocab_size=VOCAB, max_len=128, num_layers=1,
+                             num_heads=2, head_dim=32, mlp_dim=128)
+        raw1 = shallow.init(jax.random.PRNGKey(3),
+                            jnp.zeros((1, 8), jnp.int32),
+                            train=False)["params"]
+        target1 = restack_layer_trees(nn.meta.unbox(raw1))
+        with pytest.raises(ValueError, match="DEEPER"):
+            adopt_draft_checkpoint(params, target1)  # 2 layers into 1
+
+    def test_adopt_width_mismatch_refused(self, tiny):
+        _, params, _ = tiny
+        sp = restack_layer_trees(params)
+        narrow = GptDecoder(vocab_size=VOCAB, max_len=128, num_layers=1,
+                            num_heads=2, head_dim=16, mlp_dim=64)
+        raw = narrow.init(jax.random.PRNGKey(3),
+                          jnp.zeros((1, 8), jnp.int32),
+                          train=False)["params"]
+        with pytest.raises(ValueError, match="embed width"):
+            adopt_draft_checkpoint(raw, sp)
+
+    def test_draft_seq_id_never_collides(self):
+        ids = [draft_seq_id(r) for r in range(1000)]
+        assert all(d < 0 for d in ids)          # request ids are >= 0
+        assert len(set(ids)) == len(ids)
+
+
+# -- the adaptive-k controller (pure bookkeeping) --------------------------
+
+class TestAdaptiveK:
+    def req(self):
+        return Request(id=0, prompt=[1], max_new_tokens=32)
+
+    def test_starts_at_k_max_then_tracks_evidence(self):
+        ctrl = AdaptiveK(4)
+        r = self.req()
+        assert ctrl.k_for(r) == 4          # optimistic start
+        ctrl.update(r, drafted=4, accepted=1)   # rejection at position 2
+        assert r.draft_k == 2              # accepted + 1: what the round
+        #                                    proved profitable
+        ctrl.update(r, drafted=2, accepted=2)   # full accept
+        assert r.draft_k == 3              # grow by one
+        ctrl.update(r, drafted=3, accepted=3)
+        ctrl.update(r, drafted=4, accepted=4)
+        assert r.draft_k == 4              # capped at k_max
+        ctrl.update(r, drafted=4, accepted=0)
+        assert r.draft_k == 1              # total rejection floors at 1
+
+    def test_rolling_accept_rate_ewma(self):
+        ctrl = AdaptiveK(4, ema=0.5)
+        r = self.req()
+        ctrl.update(r, drafted=4, accepted=4)
+        assert ctrl.accept_rate == 1.0     # first round seeds the EWMA
+        ctrl.update(r, drafted=4, accepted=0)
+        assert ctrl.accept_rate == 0.5
+        assert r.spec_drafted == 8 and r.spec_accepted == 4
+
+    def test_disabled_controller_pins_k_max(self):
+        ctrl = AdaptiveK(3, enabled=False)
+        r = self.req()
+        assert ctrl.k_for(r) == 3
+        ctrl.update(r, drafted=3, accepted=0)
+        assert ctrl.k_for(r) == 3          # no shrink when disabled
+        assert ctrl.accept_rate == 0.0     # the EWMA still meters
+
+    def test_bad_k_max_refused(self):
+        with pytest.raises(ValueError, match="k_max"):
+            AdaptiveK(0)
+
+
+# -- KV rollback: truncate -------------------------------------------------
+
+class TestTruncate:
+    def kv(self, **kw):
+        base = dict(num_layers=2, num_heads=2, head_dim=8, num_blocks=8,
+                    block_size=4)
+        base.update(kw)
+        return PagedKVCache(**base)
+
+    def test_truncate_pops_blocks_back_to_free_list(self):
+        kv = self.kv()
+        kv.alloc(1, 10)                    # 3 blocks
+        assert kv.truncate(1, 4) == 2      # back to one block
+        assert kv.seq_len(1) == 4
+        assert kv.free_blocks() == 6
+        assert kv.stats()["free_count"] == 2
+        blk, off = kv.append_slot(1)       # regrow: the popped block reused
+        assert off == 0 and kv.blocks_used() == 2
+
+    def test_truncate_within_block_frees_nothing(self):
+        kv = self.kv()
+        kv.alloc(1, 6)                     # 2 blocks
+        assert kv.truncate(1, 5) == 0      # same block count, shorter len
+        assert kv.seq_len(1) == 5
+        assert kv.blocks_used() == 2
+
+    def test_truncate_grow_refused(self):
+        kv = self.kv()
+        kv.alloc(1, 4)
+        with pytest.raises(ValueError, match="GROW"):
+            kv.truncate(1, 5)
+
+    def test_truncate_unknown_seq_refused(self):
+        kv = self.kv()
+        with pytest.raises(KeyError):
+            kv.truncate(9, 0)
+
+
+# -- the sampling seam -----------------------------------------------------
+
+class TestSamplingSeam:
+    def test_greedy_bitwise_identical_to_greedy_decode(self):
+        from pytorch_ddp_template_tpu.ops.lm_head import (
+            greedy_decode, sample_tokens,
+        )
+
+        hidden = jax.random.normal(jax.random.PRNGKey(0), (5, 64))
+        table = jax.random.normal(jax.random.PRNGKey(1), (VOCAB, 64))
+        a = np.asarray(greedy_decode(hidden, table, block=100))
+        b = np.asarray(sample_tokens(hidden, table, policy="greedy",
+                                     block=100))
+        assert np.array_equal(a, b)        # the v1 seam is a bitwise no-op
+
+    def test_unknown_policy_refused_named(self):
+        from pytorch_ddp_template_tpu.ops.lm_head import sample_tokens
+
+        hidden = jnp.zeros((1, 8))
+        table = jnp.zeros((16, 8))
+        with pytest.raises(ValueError, match="greedy"):
+            sample_tokens(hidden, table, policy="nucleus")
+
+    def test_engine_refuses_unknown_policy_at_init(self, tiny):
+        model, params, _ = tiny
+        with pytest.raises(ValueError, match="sampling"):
+            make_engine(model, params, sampling="top_p")
+
+
+# -- the k-token batch-verify helper ---------------------------------------
+
+class TestVerifyForward:
+    def test_partial_window_matches_sequential_and_scraps_tail(self, tiny):
+        """THE satellite unit: a 3-token window inside a 5-lane verify
+        call (k not filling the compiled window) must produce, on its
+        active lanes, exactly the tokens sequential decode would have —
+        and the padded tail lanes must write ONLY null-block scrap."""
+        from pytorch_ddp_template_tpu.ops.lm_head import greedy_decode
+        from pytorch_ddp_template_tpu.serve.model import verify_forward
+
+        model, params, fused = tiny
+        ref = ref_generate(fused, params, [5, 9, 2, 7], 8)
+
+        eng = make_engine(model, params)   # plain engine: target only
+        r = eng.submit([5, 9, 2, 7], max_new_tokens=20)
+        eng.step()                         # prefill + 1 decode
+        eng.step()                         # decode
+        assert r.tokens == ref[:3]
+        n0 = eng.kv.seq_len(r.id)          # prompt + 2 decoded positions
+
+        k_cap, k_act = 5, 3
+        positions = np.zeros((1, k_cap), np.int32)
+        ctx = np.zeros((1, k_cap), np.int32)
+        wb = np.full((1, k_cap), NULL_BLOCK, np.int32)
+        wo = np.zeros((1, k_cap), np.int32)
+        tables = np.full((1, k_cap, eng.max_blocks), NULL_BLOCK, np.int32)
+        for j in range(k_act):
+            positions[0, j] = n0 + j
+            ctx[0, j] = n0 + j + 1
+            wb[0, j], wo[0, j] = eng.kv.append_slot(r.id)
+        tables[0, :k_act] = eng.kv.padded_table(r.id, eng.max_blocks)
+        # window [t_last, d_1, d_2] with the TRUE continuation as drafts
+        window = np.zeros((1, k_cap), np.int32)
+        window[0, :k_act] = [ref[2], ref[3], ref[4]]
+
+        before = {k: np.asarray(v) for k, v in eng.kv.pool.items()}
+        hidden, pool = verify_forward(
+            eng.params, eng.kv.pool, jnp.asarray(window),
+            jnp.asarray(positions), jnp.asarray(tables), jnp.asarray(ctx),
+            jnp.asarray(wb), jnp.asarray(wo), dtype=model.dtype)
+        assert hidden.shape[:2] == (1, k_cap)
+        y = np.asarray(greedy_decode(hidden.reshape(k_cap, -1),
+                                     eng.params["wte"]["embedding"]))
+        # active lanes reproduce sequential greedy decode exactly
+        assert list(y[:k_act]) == ref[3:6]
+        # padded tail lanes touched ONLY the null block's scrap space
+        owned = set(eng.kv.table(r.id)) | {NULL_BLOCK}
+        for key, arr in pool.items():
+            changed = np.nonzero(np.any(
+                np.asarray(arr) != before[key],
+                axis=tuple(range(2, arr.ndim)) + (0,)))[0]
+            assert set(changed.tolist()) <= owned, key
+
+
+# -- the engine: lossless, compile pin, rollback ---------------------------
+
+def spec_engine(model, params, **overrides):
+    base = dict(spec_k=4, draft_depth=1)
+    base.update(overrides)
+    return make_engine(model, params, **base)
+
+
+class TestSpecEngine:
+    @pytest.mark.parametrize("spec_cfg", [
+        dict(spec_k=4, draft_depth=1),
+        dict(spec_k=4, draft_depth=2),   # full-depth draft: the m==k
+        #                                  always-accept degenerate path
+        dict(spec_k=1, draft_depth=1),   # minimal window
+        dict(spec_k=3, draft_depth=1, spec_adaptive=False),
+    ], ids=["k4d1", "k4d2-full-accept", "k1d1", "k3d1-fixed"])
+    def test_lossless_mixed_length_continuous(self, tiny, spec_cfg):
+        """THE acceptance anchor: speculative greedy output is
+        token-for-token identical to the plain engine across a
+        mixed-length continuously-batched workload."""
+        model, params, fused = tiny
+        base = run_workload(make_engine(model, params))
+        spec = run_workload(spec_engine(model, params, **spec_cfg))
+        assert spec == base
+        # and the plain engine itself anchors to the unbatched reference
+        assert base[0] == ref_generate(fused, params, WORKLOAD[0][0],
+                                       WORKLOAD[0][1])
+
+    def test_full_depth_draft_always_accepts(self, tiny):
+        model, params, _ = tiny
+        eng = spec_engine(model, params, draft_depth=2)
+        run_workload(eng)
+        st = eng.stats()
+        assert st["serve_spec_accept_rate"] == 1.0
+        assert st["serve_spec_draft_depth"] == 2
+
+    def test_two_compiled_decode_programs_pin(self, tiny):
+        """The compile-count contract: draft + verify are the ONLY
+        decode programs, however sequences grow or k adapts — and a
+        second batch of different lengths adds none."""
+        model, params, _ = tiny
+        eng = spec_engine(model, params)
+        eng.submit([1, 2, 3], max_new_tokens=20)
+        eng.submit([4, 5, 6, 7, 8], max_new_tokens=17)
+        eng.run()
+        assert eng.decode_programs() == 2
+        eng.submit([9] * 11, max_new_tokens=9)
+        eng.run()
+        assert eng.decode_programs() == 2
+        # the plain decode program never traced in spec mode
+        assert eng._decode_fn._cache_size() == 0
+        assert eng._spec._draft_decode_fn._cache_size() == 1
+        assert eng._spec._verify_fn._cache_size() == 1
+
+    def test_rollback_leak_free_at_drain(self, tiny):
+        """Every rejected draft tail rolls back through the free list:
+        at drain the allocator holds nothing and lifetime alloc equals
+        lifetime free — target AND draft lanes."""
+        model, params, _ = tiny
+        eng = spec_engine(model, params)
+        run_workload(eng)
+        st = eng.kv.stats()
+        assert st["blocks_used"] == 0
+        assert st["tokens_resident"] == 0
+        assert st["alloc_count"] == st["free_count"]
+        assert st["alloc_count"] > 0
+        assert eng._committed == {}
+        assert eng.scheduler.idle()
+
+    def test_eos_mid_window_matches_baseline(self, tiny):
+        """A verify round that commits past the eos must discard the
+        tail — exactly the tokens the baseline never emits."""
+        model, params, fused = tiny
+        ref = ref_generate(fused, params, [5, 6, 7], 8)
+        eos = ref[2]
+        base = make_engine(model, params, eos_id=eos)
+        rb = base.submit([5, 6, 7], max_new_tokens=8)
+        spec = spec_engine(model, params, eos_id=eos)
+        rs = spec.submit([5, 6, 7], max_new_tokens=8)
+        assert spec.run()[rs.id] == base.run()[rb.id] == ref[:3]
+
+    def test_int8_kv_spec_lossless_vs_int8_plain(self, tiny):
+        """Spec mode composes with the r17 int8 KV pool: quantized
+        gather-KV greedy decode with and without speculation agree."""
+        model, params, _ = tiny
+        base = run_workload(make_engine(model, params, kv_quant="int8"),
+                            WORKLOAD[:4])
+        spec = run_workload(spec_engine(model, params, kv_quant="int8"),
+                            WORKLOAD[:4])
+        assert spec == base
+
+    def test_admission_reserves_draft_lanes(self, tiny):
+        """Spec admission doubles the worst-case block commit: with a
+        pool sized for two doubled requests, the third queues instead
+        of admitting into an OOM — and everything still finishes."""
+        model, params, _ = tiny
+        # budget 14 usable; plen 4 + max_new 8 -> 3 blocks -> 6 doubled
+        eng = spec_engine(model, params, num_blocks=15)
+        reqs = [eng.submit([7, 7, 7, 7], max_new_tokens=8)
+                for _ in range(3)]
+        eng.step()
+        assert eng.scheduler.active() == 2       # third held back
+        out = eng.run()
+        assert all(len(out[r.id]) == 8 for r in reqs)
+        assert eng.kv.stats()["blocks_used"] == 0
+
+    def test_unadmittable_request_refused_with_spec_hint(self, tiny):
+        model, params, _ = tiny
+        eng = spec_engine(model, params, num_blocks=9)
+        with pytest.raises(ValueError, match="doubles the reservation"):
+            eng.submit([1, 2, 3, 4], max_new_tokens=16)  # 5 blocks * 2 > 8
+
+    def test_draft_params_without_spec_k_refused(self, tiny):
+        model, params, _ = tiny
+        sp = restack_layer_trees(params)
+        with pytest.raises(ValueError, match="spec_k"):
+            ServeEngine(model, params,
+                        ServeConfig(block_size=4, num_blocks=64,
+                                    max_slots=3, max_model_len=64),
+                        draft_params=make_draft_params(sp, 1))
+
+    def test_spec_stats_fields_affirmative(self, tiny):
+        model, params, _ = tiny
+        eng = spec_engine(model, params)
+        run_workload(eng)
+        st = eng.stats()
+        assert st["serve_spec_k_max"] == 4
+        assert st["serve_spec_draft_depth"] == 1
+        assert 0.0 <= st["serve_spec_accept_rate"] <= 1.0
+        assert 0.0 <= st["serve_spec_accept_rate_rolling"] <= 1.0
+        # the wager pays: > 1 committed token per target verify step
+        assert st["serve_spec_accepted_per_target_step"] > 1.0
+        # every token past each request's prefill-emitted first token
+        # came through a verify round
+        assert st["serve_spec_committed_total"] == sum(
+            n for _, n in WORKLOAD) - len(WORKLOAD)
+        assert (st["serve_spec_accepted_total"]
+                <= st["serve_spec_drafted_total"])
+        assert st["serve_spec_draft_s_total"] > 0
+        assert st["serve_spec_verify_s_total"] > 0
+        assert st["serve_spec_verify_steps"] <= st["serve_spec_draft_steps"]
+
+
+# -- the draft-checkpoint workflow -----------------------------------------
+
+class TestDraftCheckpointSeam:
+    def save_ckpt(self, tmp_path, name, params):
+        from pytorch_ddp_template_tpu.checkpoint.manager import (
+            CheckpointManager,
+        )
+        from pytorch_ddp_template_tpu.config import TrainingConfig
+
+        state = {"step": jnp.int32(7), "params": params,
+                 "rng": jax.random.PRNGKey(1)}
+        cfg = TrainingConfig(model="gpt-tiny",
+                             output_dir=str(tmp_path / f"{name}_out"))
+        mngr = CheckpointManager(tmp_path / name)
+        mngr.save(7, state, cfg, force=True)
+        mngr.wait()
+        mngr.close()
+        return tmp_path / name
+
+    def test_from_checkpoint_with_draft_dir_is_lossless(self, tiny,
+                                                        tmp_path):
+        """The --num_layers workflow end-to-end: an independently
+        initialised 1-layer checkpoint adopts as the draft through
+        from_checkpoint(draft_dir=...), and the output is STILL
+        token-for-token the plain engine's — draft weights only ever
+        move the acceptance rate."""
+        model, params, _ = tiny
+        target_dir = self.save_ckpt(tmp_path, "target", params)
+        shallow = GptDecoder(vocab_size=VOCAB, max_len=128, num_layers=1,
+                             num_heads=2, head_dim=32, mlp_dim=128)
+        raw = nn.meta.unbox(shallow.init(
+            jax.random.PRNGKey(9), jnp.zeros((1, 8), jnp.int32),
+            train=False)["params"])
+        draft_dir = self.save_ckpt(tmp_path, "draft", raw)
+
+        eng = ServeEngine.from_checkpoint(
+            target_dir, model,
+            ServeConfig(block_size=4, num_blocks=64, max_slots=3,
+                        max_model_len=64, spec_k=3),
+            draft_dir=draft_dir)
+        assert eng._spec is not None and eng._spec.depth == 1
+        base = run_workload(make_engine(model, params), WORKLOAD[:4])
+        spec = run_workload(eng, WORKLOAD[:4])
+        assert spec == base
+        assert eng.stats()["serve_spec_draft_depth"] == 1
+
+    def test_draft_depth_conflicting_with_checkpoint_refused(self, tiny,
+                                                             tmp_path):
+        model, params, _ = tiny
+        shallow = GptDecoder(vocab_size=VOCAB, max_len=128, num_layers=1,
+                             num_heads=2, head_dim=32, mlp_dim=128)
+        raw = nn.meta.unbox(shallow.init(
+            jax.random.PRNGKey(9), jnp.zeros((1, 8), jnp.int32),
+            train=False)["params"])
+        with pytest.raises(ValueError, match="inferred"):
+            ServeEngine(model, params,
+                        ServeConfig(block_size=4, num_blocks=64,
+                                    max_slots=3, max_model_len=64,
+                                    spec_k=3, draft_depth=2),
+                        draft_params=raw)
+
+
+# -- the --num_layers training knob ----------------------------------------
+
+class TestNumLayersKnob:
+    def test_build_overrides_depth(self):
+        from pytorch_ddp_template_tpu.config import TrainingConfig
+        from pytorch_ddp_template_tpu.models.registry import build
+
+        cfg = TrainingConfig(model="gpt-tiny", output_dir="/tmp/nl",
+                             num_layers=1)
+        task, _ = build("gpt-tiny", cfg)
+        assert task.model.num_layers == 1
+
+    def test_depthless_model_refused_named(self):
+        from pytorch_ddp_template_tpu.config import TrainingConfig
+        from pytorch_ddp_template_tpu.models.registry import build
+
+        cfg = TrainingConfig(model="mlp", output_dir="/tmp/nl",
+                             num_layers=1)
+        with pytest.raises(ValueError, match="num_layers"):
+            build("mlp", cfg)
+
+    def test_negative_refused(self):
+        from pytorch_ddp_template_tpu.config import TrainingConfig
+
+        with pytest.raises(ValueError, match="num_layers"):
+            TrainingConfig(model="gpt-tiny", output_dir="/tmp/nl",
+                           num_layers=-1)
+
+
+# -- obs wiring ------------------------------------------------------------
+
+class TestSpecObs:
+    def test_metrics_gauges_live(self, tiny):
+        from pytorch_ddp_template_tpu.obs.server import StatusServer
+
+        model, params, _ = tiny
+        status = StatusServer(0)
+        status.start()
+        try:
+            eng = ServeEngine(
+                model, params,
+                ServeConfig(block_size=4, num_blocks=64, max_slots=2,
+                            max_model_len=64, spec_k=3, draft_depth=1),
+                status=status)
+            eng.submit([1, 2, 3, 4], max_new_tokens=8)
+            eng.run()
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{status.port}/metrics",
+                    timeout=10) as resp:
+                text = resp.read().decode()
+            assert "tpuddp_serve_spec_accept_rate" in text
+            assert "tpuddp_serve_spec_accepted_per_target_step" in text
+            assert "tpuddp_serve_spec_draft_depth" in text
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{status.port}/status",
+                    timeout=10) as resp:
+                doc = json.loads(resp.read().decode())
+            assert doc["serve"]["config"]["spec_k"] == 3
+        finally:
+            status.close()
+
+    def test_goodput_serve_draft_bucket(self, tiny, tmp_path):
+        from pytorch_ddp_template_tpu.obs.goodput import (
+            BUCKETS, GoodputLedger,
+        )
+
+        assert "serve_draft" in BUCKETS
+        model, params, _ = tiny
+        ledger = GoodputLedger(tmp_path)
+        eng = ServeEngine(
+            model, params,
+            ServeConfig(block_size=4, num_blocks=64, max_slots=2,
+                        max_model_len=64, spec_k=3, draft_depth=1),
+            goodput=ledger)
+        eng.submit([1, 2, 3], max_new_tokens=8)
+        eng.run()
+        tot = ledger.totals()
+        assert tot["serve_draft"] > 0.0
+        assert tot["serve_decode"] > 0.0    # verify wall stays in decode
+
+
+# -- the committed BENCH_MODE=spec record ----------------------------------
+
+def test_spec_record_committed_and_affirmative():
+    """The committed round-20 record must carry the acceptance
+    evidence: accepted tokens per target step > 1 with the draft's
+    FLOPs accounted, the two-program compile pin for BOTH spec
+    programs, losslessness re-checked inside the bench, and the
+    live-gauges proof."""
+    import pathlib
+
+    path = (pathlib.Path(__file__).resolve().parents[1]
+            / "bench_records" / "spec_cpu_r20.jsonl")
+    assert path.is_file(), "run BENCH_MODE=spec to record the legs"
+    rows = [json.loads(s) for s in path.read_text().splitlines() if s]
+    head = rows[0]
+    assert head["metric"] == "serve_spec_accepted_per_target_step"
+    assert head["value"] > 1.0 and head["vs_baseline"] >= 1.0
+    # the FLOPs wager stated, not hidden: the draft+verify path's
+    # useful-FLOPs-per-emitted-token ratio vs plain decode
+    assert head["spec_flops_per_token_ratio"] > 0
+    assert head["accepted_per_target_step_flops_adj"] > 1.0
+    assert 0.0 < head["accept_rate"] <= 1.0
+    assert head["decode_zero_recompile"] is True
+    assert head["decode_programs"] == 2
+    assert head["draft_programs"] == 1 and head["verify_programs"] == 1
+    assert head["spec_lossless_checked"] is True
+    assert head["metrics_gauges_live"] is True
+    assert head["goodput_serve_draft_s"] > 0
+    # the headline is the honest config: not an ablation row
+    assert not head.get("draft_depth") and not head.get("spec_k")
+    assert head["spec_k_max"] >= 1 and head["spec_draft_depth"] >= 1
+    # the depth ablation rows: marked as ablations, spanning depths
+    abl = [r for r in rows if r.get("draft_depth")]
+    assert len(abl) >= 2, "draft_depth ablation rows missing"
+    depths = {r["draft_depth"] for r in abl}
+    assert len(depths) >= 2

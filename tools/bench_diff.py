@@ -43,7 +43,8 @@ import sys
 #: root on sys.path — the two lists are pinned equal by a test)
 ABLATION_KEYS = ("remat", "fused_head", "dense_head", "flash_disabled",
                  "num_layers", "scan_layers", "ddp_overlap", "tp_overlap",
-                 "fsdp_overlap", "quant_compute", "kv_quant", "paged_impl")
+                 "fsdp_overlap", "quant_compute", "kv_quant", "paged_impl",
+                 "spec_k", "draft_depth")
 
 
 def _paths(target: str) -> list[str]:

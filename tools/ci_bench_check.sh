@@ -23,6 +23,12 @@
 #                                               # zero-recompile pin, live
 #                                               # gauges) and gate it vs the
 #                                               # committed serve record
+#   RUN_SPEC=1 bash tools/ci_bench_check.sh     # r20: run BENCH_MODE=spec
+#                                               # fresh (CPU: speculative
+#                                               # acceptance + FLOPs-adjusted
+#                                               # win, lossless re-check, the
+#                                               # two-program pin) and gate it
+#                                               # vs the committed spec record
 #
 # Exit codes are bench_diff's: 0 in-band, 1 drift, 2 no overlap/usage
 # (an empty comparison must not read as green). Output is the github
@@ -36,7 +42,8 @@ TOLERANCE=${TOLERANCE:-0.25}
 # fresh-leg flags share ONE scratch dir so RUN_SERVE=1 RUN_ELASTIC=1
 # gates both records (a later block overwriting CANDIDATE would silently
 # discard the earlier run)
-if [ "${RUN_SERVE:-0}" = "1" ] || [ "${RUN_ELASTIC:-0}" = "1" ]; then
+if [ "${RUN_SERVE:-0}" = "1" ] || [ "${RUN_ELASTIC:-0}" = "1" ] \
+    || [ "${RUN_SPEC:-0}" = "1" ]; then
   FRESH_DIR=$(mktemp -d)
   CANDIDATE=$FRESH_DIR
 fi
@@ -46,6 +53,14 @@ if [ "${RUN_SERVE:-0}" = "1" ]; then
   # (compile pass + timed pass per policy)
   BENCH_CPU=${BENCH_CPU:-1} BENCH_MODE=serve \
     timeout 900 python bench.py | tee "$FRESH_DIR/serve_fresh.jsonl"
+fi
+
+if [ "${RUN_SPEC:-0}" = "1" ]; then
+  # the spec leg replays the serve workload through the speculative
+  # engine (draft + one-dispatch verify) against the plain engine,
+  # re-checking losslessness inside the run
+  BENCH_CPU=${BENCH_CPU:-1} BENCH_MODE=spec \
+    timeout 1200 python bench.py | tee "$FRESH_DIR/spec_fresh.jsonl"
 fi
 
 if [ "${RUN_ELASTIC:-0}" = "1" ]; then

@@ -19,10 +19,10 @@
 # bench mode appends one JSON line to its round's records file.
 # Usage: bash tools/tpu_followup.sh <round>   (requires the axon tunnel)
 set -u
-ROUND=${1:?usage: tpu_followup.sh <round: 4..19>}
+ROUND=${1:?usage: tpu_followup.sh <round: 4..20>}
 case "$ROUND" in (*[!0-9]*|'') echo "round must be a number, got '$ROUND'" >&2; exit 2;; esac
-if [ "$ROUND" -lt 4 ] || [ "$ROUND" -gt 19 ]; then
-  echo "unknown round $ROUND (expected 4..19)" >&2; exit 2
+if [ "$ROUND" -lt 4 ] || [ "$ROUND" -gt 20 ]; then
+  echo "unknown round $ROUND (expected 4..20)" >&2; exit 2
 fi
 cd "$(dirname "$0")/.."
 R=bench_records
@@ -342,6 +342,26 @@ legs_r19() {
   python tools/bench_diff.py "$R" "$R/serve_tpu_r19.jsonl" --format github \
     > "$R/bench_diff_tpu_r19.md" 2>>"$ERR" \
     || echo "bench_diff flagged drift (see bench_diff_tpu_r19.md)" >&2
+}
+
+legs_r20() {
+  # speculative decoding: the BENCH_MODE=spec legs on real chips. The
+  # CPU record (spec_cpu_r20.jsonl) proves losslessness, the two-program
+  # compile pin and the FLOPs-accounted acceptance win; chips are needed
+  # for (a) the real spec-on vs spec-off tokens/sec pair under MXU
+  # decode — the memory-bound regime the wager actually targets (each
+  # record carries tokens_per_sec_spec/tokens_per_sec_plain from the
+  # same run), (b) the acceptance + depth sweep at silicon latency
+  # (every invocation appends its depth-ablation rows), and (c) the
+  # tpuddp_serve_spec_* gauges scraped from a chip-backed engine
+  # (metrics_gauges_live in each record).
+  run spec_headline spec_tpu_r20.jsonl 1200 BENCH_MODE=spec
+  run spec_k8       spec_tpu_r20.jsonl 1200 BENCH_MODE=spec BENCH_SPEC_K=8
+  run spec_fixed_k  spec_tpu_r20.jsonl 1200 BENCH_MODE=spec BENCH_SPEC_DEPTHS=1
+  run serve_plain   serve_tpu_r19.jsonl 1200 BENCH_MODE=serve
+  python tools/bench_diff.py "$R" "$R/spec_tpu_r20.jsonl" --format github \
+    > "$R/bench_diff_tpu_r20.md" 2>>"$ERR" \
+    || echo "bench_diff flagged drift (see bench_diff_tpu_r20.md)" >&2
 }
 
 # -- the historical chain ---------------------------------------------------
