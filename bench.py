@@ -50,7 +50,7 @@ from pytorch_ddp_template_tpu.obs.attribution import (  # noqa: E402
     PEAK_FLOPS, cost_of,
 )
 
-MODE = os.environ.get("BENCH_MODE", "train")  # train | e2e | scaling | flash | compile | overlap | comms | tp | overlap3d | obs | perf | fleet | mem | pipe | quant | elastic | serve | spec
+MODE = os.environ.get("BENCH_MODE", "train")  # train | e2e | scaling | flash | compile | overlap | comms | tp | overlap3d | obs | perf | fleet | mem | pipe | quant | elastic | serve | spec | serve_tp
 MODEL = os.environ.get("BENCH_MODEL", "resnet50")
 WARMUP_STEPS = int(os.environ.get("BENCH_WARMUP", "5"))
 TIMED_STEPS = int(os.environ.get("BENCH_STEPS", "30"))
@@ -73,7 +73,7 @@ def _emit(payload: dict) -> None:
 ABLATION_KEYS = ("remat", "fused_head", "dense_head", "flash_disabled",
                  "num_layers", "scan_layers", "ddp_overlap", "tp_overlap",
                  "fsdp_overlap", "quant_compute", "kv_quant", "paged_impl",
-                 "spec_k", "draft_depth")
+                 "spec_k", "draft_depth", "tp_degree")
 
 
 def _last_recorded(metric: str) -> dict | None:
@@ -3945,6 +3945,216 @@ def run_spec() -> list:
     return rows
 
 
+def run_serve_tp() -> list:
+    """Tensor-parallel decode proof (round 21,
+    ``serve/model.tp_decode_forward``): the ring-sharded decode program
+    must be token-for-token identical to single-replica greedy on the
+    SAME requests (FLOPs-matched — identical prompts, budgets, model
+    and params; the tp twin differs ONLY in ``tp_overlap`` + mesh),
+    hold the one-compiled-decode-program pin over two full workload
+    passes of sequence growth, and show ring evidence in its own HLO
+    (``obs/hlo_report.ring_evidence``: dot-carrying while bodies whose
+    collective-permutes are compute-independent — the schedule the
+    latency-hiding scheduler can overlap).
+
+    The tokens/sec pair (tp=2 vs single replica) is recorded honestly:
+    on the CPU interpreter the ring pays real ppermute overhead for no
+    memory-bandwidth win, so the ratio is informational there — the
+    acceptance bar is parity + the compile pin + ring evidence; the
+    real-chip pair is ``tools/tpu_followup.sh legs_r21``'s to take.
+
+    Emits the headline first, then one ablation-marked row (literal
+    ``tp_degree``/``quant_compute`` keys — bench_diff skips it) for the
+    quantized ring wire: same parity bar, narrower wire (the headline
+    spells its config ``serve_tp_degree``, the ``describe_tp``
+    convention).
+
+    Hosts with fewer than 2 devices emit ``degenerate: true`` with
+    value 0 (the r8 convention) — a phantom ring must not masquerade
+    as a measured pair.
+
+    Knobs: BENCH_SERVE_TP_REQUESTS (default 16), BENCH_SERVE_TP_SLOTS
+    (default 4), BENCH_SERVE_TP (tp degree, default 2).
+    """
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_ddp_template_tpu.models.gpt import gpt_tiny
+    from pytorch_ddp_template_tpu.obs.hlo_report import ring_evidence
+    from pytorch_ddp_template_tpu.obs.server import StatusServer
+    from pytorch_ddp_template_tpu.serve import ServeConfig, ServeEngine
+
+    n_req = int(os.environ.get("BENCH_SERVE_TP_REQUESTS", "16"))
+    slots = int(os.environ.get("BENCH_SERVE_TP_SLOTS", "4"))
+    tp_size = int(os.environ.get("BENCH_SERVE_TP", "2"))
+    devices = jax.devices()
+    platform = devices[0].platform
+    n_dev = len(devices)
+    metric = "serve_tp_vs_single_replica"
+    unit = "x_single_replica_tokens_per_sec"
+    if n_dev < 2 or n_dev % tp_size or slots % tp_size:
+        return [{  # single-chip: no model axis to ring over (r8 conv.)
+            "metric": metric, "value": 0.0, "unit": unit,
+            "vs_baseline": 0.0, "degenerate": True,
+            "platform": platform, "device_kind": devices[0].device_kind,
+            "n_devices": n_dev, "tp_size": tp_size,
+            "note": "tp decode needs a model:N>=2 mesh axis dividing "
+                    "max_slots",
+        }]
+
+    import dataclasses as _dc
+
+    import flax.linen as nn
+    from jax.sharding import Mesh
+
+    model = gpt_tiny(vocab_size=512, seq_len=256)
+    params = nn.meta.unbox(model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32),
+        train=False)["params"])
+    tp_model = _dc.replace(model, tp_overlap=True)
+    data_size = n_dev // tp_size
+    mesh = Mesh(np.asarray(devices).reshape(data_size, tp_size),
+                ("data", "model"))
+
+    # the r19 workload shape: one long straggler per admission wave
+    rng = np.random.RandomState(0)
+    requests = []
+    for i in range(n_req):
+        plen = int(rng.randint(4, 17))
+        max_new = 64 if i % slots == 0 else int(rng.randint(4, 9))
+        requests.append(([int(t) for t in rng.randint(0, 512, plen)],
+                         max_new))
+    total_new = sum(m for _, m in requests)
+
+    def make_engine(m, mesh_=None, status=None, quant="off"):
+        return ServeEngine(
+            _dc.replace(m, quant_compute=quant) if quant != "off" else m,
+            params,
+            ServeConfig(block_size=16, num_blocks=256, max_slots=slots,
+                        max_model_len=128),
+            mesh=mesh_, status=status)
+
+    def drive(eng):
+        reqs = [eng.submit(prompt, max_new_tokens=max_new)
+                for prompt, max_new in requests]
+        t0 = time.perf_counter()
+        eng.run()
+        wall = time.perf_counter() - t0
+        tokens = sum(len(r.tokens) for r in reqs)
+        assert tokens == total_new, (tokens, total_new)
+        return [list(r.tokens) for r in reqs], tokens / wall
+
+    # -- single-replica oracle + FLOPs-matched baseline side
+    eng_p = make_engine(model)
+    base_out, _ = drive(eng_p)  # compile pass
+    _, tps_plain = drive(eng_p)  # warm pass
+
+    # -- the TP engine: parity + compile pin + gauges, two passes
+    status = StatusServer(0)
+    status.start()
+    try:
+        eng = make_engine(tp_model, mesh_=mesh, status=status)
+        tp_out, _ = drive(eng)  # compile pass
+        tp_out2, tps_tp = drive(eng)  # warm pass
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{status.port}/metrics",
+                timeout=10) as resp:
+            metrics_text = resp.read().decode()
+    finally:
+        status.close()
+    gauges_live = "tpuddp_serve_tp_degree" in metrics_text
+    lossless = tp_out == base_out and tp_out2 == base_out
+    zero_recompile = (eng.decode_programs() == 1
+                      and eng_p.decode_programs() == 1)
+
+    # -- HLO ring evidence: lower the engine's OWN decode callable on
+    # engine-shaped inputs and count independent ring bodies
+    s = eng.cfg.max_slots
+    mb = eng.max_blocks
+    lowered = eng._decode_fn.lower(
+        eng.params, eng.kv.pool,
+        jnp.zeros((s,), jnp.int32), jnp.zeros((s,), jnp.int32),
+        jnp.zeros((s, mb), jnp.int32), jnp.zeros((s,), jnp.int32),
+        jnp.zeros((s,), jnp.int32), jnp.zeros((s,), jnp.int32))
+    # AOT-compile the lowering (does not touch the jit cache — the
+    # zero-recompile pin above is already taken): ring_evidence reads
+    # optimized HLO, where the scan bodies and ppermutes are visible
+    ev = ring_evidence(lowered.compile().as_text())
+
+    # -- the quantized ring wire, same parity bar (ablation row)
+    eng_q = make_engine(tp_model, mesh_=mesh, quant="int8")
+    q_out, _ = drive(eng_q)
+    q_lossless = q_out == base_out
+
+    ratio = tps_tp / tps_plain if tps_plain else 0.0
+    tp_desc = eng.describe_tp()
+    rec = {
+        "metric": metric,
+        "value": round(ratio, 3),
+        # FLOPs-matched pair: same requests, same params, the tp twin
+        # differs only in sharding. Informational on CPU (see above);
+        # parity + pin + ring evidence are the acceptance bar
+        "unit": unit,
+        "vs_baseline": round(ratio, 4),
+        "platform": platform,
+        "device_kind": devices[0].device_kind,
+        "n_devices": n_dev,
+        "model": "gpt-tiny",
+        "requests": n_req,
+        "max_slots": slots,
+        "total_new_tokens": total_new,
+        # headline config spelling (NOT the literal ablation key)
+        **tp_desc,
+        "tokens_per_sec_tp": round(tps_tp, 2),
+        "tokens_per_sec_single_replica": round(tps_plain, 2),
+        # the tentpole's token-for-token pin, re-checked INSIDE the
+        # bench over both passes
+        "tp_lossless_checked": lossless,
+        "tp_quant_wire_lossless_checked": q_lossless,
+        # the compile pin: TP decode is still exactly ONE program over
+        # two passes of block-boundary growth
+        "decode_zero_recompile": zero_recompile,
+        "decode_programs": eng.decode_programs(),
+        "prefill_programs": eng.prefill_programs(),
+        # ring witness in the decode program's own HLO
+        "hlo_ring_bodies": ev["ring_bodies"],
+        "hlo_independent_ring_bodies": ev["independent_ring_bodies"],
+        "metrics_gauges_live": gauges_live,
+    }
+    if not lossless:
+        # a sharded decode that changes tokens is broken, full stop
+        rec["value"] = 0.0
+        rec["error"] = ("tp decode output != single-replica greedy "
+                        "(token-for-token pin)")
+    elif not zero_recompile:
+        rec["value"] = 0.0
+        rec["error"] = (f"decode recompiled: {eng.decode_programs()} "
+                        "programs in cache (expected 1)")
+    elif not ev["independent_ring_bodies"]:
+        rec["value"] = 0.0
+        rec["error"] = ("no independent ring bodies in the decode HLO "
+                        "(ring schedule not in evidence)")
+    rows = [rec]
+    rows.append({
+        "metric": "serve_tp_quant_wire_ablation",
+        "value": tp_desc["serve_tp_ring_wire_mb_per_step_quant"],
+        "unit": "mb_per_step",
+        "vs_baseline": 0.0,  # ablation rows are never the headline
+        "platform": platform,
+        "model": "gpt-tiny",
+        # literal ablation keys: bench_diff skips this row
+        "tp_degree": tp_size,
+        "quant_compute": "int8",
+        "wire_mb_wide": tp_desc["serve_tp_ring_wire_mb_per_step_wide"],
+        "tp_lossless_checked": q_lossless,
+        "decode_programs": eng_q.decode_programs(),
+    })
+    return rows
+
+
 def run_scaling(model: str) -> dict:
     """DDP scaling sweep: per-chip throughput on data:1/2/4/... sub-meshes.
 
@@ -4161,6 +4371,9 @@ def main() -> None:
         elif MODE == "spec":
             for rec in run_spec():
                 _emit(rec)  # headline first, then the marked ablations
+        elif MODE == "serve_tp":
+            for rec in run_serve_tp():
+                _emit(rec)  # headline first, then the marked ablation
         elif MODE == "e2e":
             _emit(run_e2e(model, metric, unit, baseline))
         elif MODE == "train":
@@ -4170,7 +4383,7 @@ def main() -> None:
                 f"unknown BENCH_MODE {MODE!r}; expected "
                 "train|e2e|scaling|flash|compile|overlap|comms|tp|"
                 "overlap3d|obs|perf|fleet|mem|pipe|quant|elastic|serve|"
-                "spec"
+                "spec|serve_tp"
             )
     except KeyboardInterrupt:  # operator abort is not a value-0 datum
         raise

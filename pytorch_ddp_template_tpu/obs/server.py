@@ -119,6 +119,18 @@ _RECORD_HELP = {
                                  "dispatch per round)",
     "serve_spec_k_mean": "mean adaptive draft window over running "
                          "requests",
+    "serve_tp_degree": "model-axis shards the ring decode program "
+                       "spans (1 = single-replica path)",
+    "serve_tp_ring_wire_mb_per_step": "decode-step ring bytes actually "
+                                      "on the wire (quantized when "
+                                      "--quant_compute rides the ring)",
+    "serve_tp_ring_wire_mb_per_step_wide": "decode-step ring bytes at "
+                                           "full f32 chunk width",
+    "serve_tp_ring_wire_mb_per_step_quant": "decode-step ring bytes at "
+                                            "the r17 int8 wire width",
+    "serve_tp_kv_pool_bytes_per_shard": "paged KV pool residency per "
+                                        "model shard (heads split over "
+                                        "the ring)",
 }
 
 

@@ -205,7 +205,8 @@ def lm_head_loss(hidden, table, targets, *, bias=None, block: int = 8192):
                              targets.astype(jnp.int32), block, vocab)
 
 
-def greedy_decode(hidden, table, *, bias=None, block: int = 8192):
+def greedy_decode(hidden, table, *, bias=None, block: int = 8192,
+                  vocab: int | None = None):
     """Blockwise greedy decode: ``argmax_v(hidden @ table.T + bias)``
     without ever materialising the ``(..., V)`` logits.
 
@@ -223,17 +224,21 @@ def greedy_decode(hidden, table, *, bias=None, block: int = 8192):
       table: ``(V, E)`` tied embedding/output table.
       bias: optional ``(V,)`` output bias.
       block: vocab tile width.
+      vocab: true vocab size when ``table`` carries pad rows beyond it
+        (the TP serving engine pads the tied table to ring granularity
+        at placement); rows ``>= vocab`` are masked out of the argmax.
 
     Returns ``(...,)`` int32 argmax token ids. Ties break toward the
     lowest vocab id regardless of block visit order (the
     :func:`_argmax_step` invariant — pinned by unit test).
     """
-    vocab, _ = table.shape
-    block = min(block, vocab)
-    n = _num_blocks(vocab, block)
-    pad = n * block - vocab
+    rows, _ = table.shape
+    vocab = rows if vocab is None else min(vocab, rows)
+    block = min(block, rows)
+    n = _num_blocks(rows, block)
+    pad = n * block - rows
     if bias is None:
-        bias = jnp.zeros((vocab,), jnp.float32)
+        bias = jnp.zeros((rows,), jnp.float32)
     if pad:
         table = jnp.pad(table, ((0, pad), (0, 0)))
         bias = jnp.pad(bias, (0, pad))
@@ -258,7 +263,7 @@ SAMPLING_POLICIES = ("greedy",)
 
 
 def sample_tokens(hidden, table, *, policy: str = "greedy", bias=None,
-                  block: int = 8192):
+                  block: int = 8192, vocab: int | None = None):
     """The serving engine's sampling seam over the online-argmax bundle.
 
     One dispatcher between "final hidden states" and "next token ids",
@@ -274,7 +279,7 @@ def sample_tokens(hidden, table, *, policy: str = "greedy", bias=None,
             f"unknown sampling policy {policy!r}; v1 serves "
             f"{SAMPLING_POLICIES} (temperature/top-k land as a blockwise "
             "Gumbel-max fold on this same seam)")
-    return greedy_decode(hidden, table, bias=bias, block=block)
+    return greedy_decode(hidden, table, bias=bias, block=block, vocab=vocab)
 
 
 # -- TP ring head (--tp_overlap): model-sharded vocab, rotating stats ------
@@ -304,6 +309,19 @@ def _tp_pad_seq(x, n, axis=1):
         widths[axis] = (0, pad)
         x = jnp.pad(x, widths)
     return x, t
+
+
+def tp_head_geometry(vocab: int, n: int, block: int = 8192):
+    """``(block, shard_rows, pad_v)`` for a vocab table sharded over an
+    ``n``-way model ring: the local shard is a whole number of blocks,
+    and the global table is padded to ``n * shard_rows`` rows. ONE
+    source of truth shared by :func:`tp_lm_head_loss`,
+    :func:`tp_greedy_decode`, and the serving engine (which pads the
+    tied table once at placement so the decode program's local shards
+    line up with this geometry)."""
+    block = min(block, -(-vocab // n))
+    vs = _num_blocks(-(-vocab // n), block) * block
+    return block, vs, n * vs - vocab
 
 
 def _tp_head_fwd_local(h, tgt, tab, bs, block, vocab):
@@ -451,9 +469,7 @@ def tp_lm_head_loss(hidden, table, targets, mesh, *, bias=None,
     vocab, _ = table.shape
     # local shard = a whole number of blocks; pad the global table to
     # n * vs rows (absolute-id masking keeps padded rows at -inf)
-    block = min(block, -(-vocab // n))
-    vs = _num_blocks(-(-vocab // n), block) * block
-    pad_v = n * vs - vocab
+    block, vs, pad_v = tp_head_geometry(vocab, n, block)
     if bias is None:
         bias = jnp.zeros((vocab,), jnp.float32)
     if pad_v:
@@ -476,3 +492,137 @@ def tp_lm_head_loss(hidden, table, targets, mesh, *, bias=None,
     )(hidden_p, targets_p, table, bias)
     # slice the seq padding back off
     return logp[:, :t_real], best[:, :t_real]
+
+
+# -- TP ring decode head (serving): rotating (hidden-chunk, argmax) --------
+#
+# The decode twin of the ring above (r21): the vocab shards stay
+# RESIDENT, and per decode step each device's (hidden-chunk, running-
+# argmax) bundle rotates around the model ring — forward-only, no
+# logsumexp, no label, no custom_vjp. After n hops the chunk is home
+# carrying the complete argmax over the full vocab; the logits row never
+# exists on any device and no shard ever holds more than V/n table rows.
+# The wire can ride the r17 quant path: the hidden chunk is quantized
+# ONCE before the loop (it only rotates, it never changes), so the
+# ppermute carries the narrow ints + per-row f32 scales while the
+# per-block logit dots stay f32 on the MXU.
+
+
+def tp_greedy_decode_local(h, tab, bs, *, block: int, vocab: int,
+                           quant: str = "off"):
+    """Per-shard rotating-argmax: fold the LOCAL vocab shard's blockwise
+    logits into each visiting chunk's running argmax. Call inside a
+    ``shard_map`` region with a live ``model`` axis — the serving
+    engine's TP decode program runs this at the tail of its one region
+    (``serve/model.tp_decode_forward``). ``tab (vs, E)`` / ``bs (vs,)``
+    are this shard's rows of the :func:`tp_head_geometry`-padded global
+    table. Returns ``(...,)`` int32 argmax ids for the home chunk."""
+    from ..parallel.ring import axis_size, ring_perm
+    from ..runtime.context import MODEL_AXIS
+
+    n = axis_size(MODEL_AXIS)
+    perm = ring_perm(n)
+    vs = tab.shape[0]
+    nb = vs // block
+    off = lax.axis_index(MODEL_AXIS) * vs
+    shape = h.shape[:-1]
+    if quant != "off":
+        from .quant import dequantize, quantize_channel
+
+        # quantize once: the chunk is pure cargo — every hop after the
+        # first carries the narrow wire, and every shard (home included,
+        # after the full circle) scores the SAME quantized hidden
+        hq, hs = quantize_channel(h.astype(jnp.float32), quant, axes=-1)
+        bundle0 = (hq, hs)
+        unpack = lambda b: dequantize(*b)  # noqa: E731
+    else:
+        bundle0 = (h,)
+        unpack = lambda b: b[0]  # noqa: E731
+
+    def ring_step(carry, _):
+        # rotate FIRST: the bundle is loop-carried state only — the hop
+        # is compute-independent of this step's logit dots
+        bundle, stats = lax.ppermute(carry, MODEL_AXIS, perm)
+        h_c = unpack(bundle)
+
+        def vblock(st, s):
+            logits, _ = _block_logits(h_c, tab, bs, s, block=block,
+                                      vocab=vocab, offset=off)
+            return _argmax_step(*st, logits, off + s * block), None
+
+        stats, _ = lax.scan(vblock, stats, jnp.arange(nb))
+        return (bundle, stats), None
+
+    init = (bundle0, (jnp.full(shape, NEG_INF, jnp.float32),
+                      jnp.zeros(shape, jnp.int32)))
+    (_, (_, best_i)), _ = lax.scan(ring_step, init, jnp.arange(n))
+    return best_i
+
+
+def tp_sample_tokens_local(h, tab, bs, *, policy: str = "greedy",
+                           block: int, vocab: int, quant: str = "off"):
+    """The in-region twin of :func:`sample_tokens`: the TP decode
+    program's sampling seam. Same policy registry, same trace-time
+    refusal — a policy added to :data:`SAMPLING_POLICIES` must land its
+    ring form here or be refused before any TP engine serves it."""
+    if policy not in SAMPLING_POLICIES:
+        raise ValueError(
+            f"unknown sampling policy {policy!r}; v1 serves "
+            f"{SAMPLING_POLICIES} (temperature/top-k land as a blockwise "
+            "Gumbel-max fold on this same seam)")
+    return tp_greedy_decode_local(h, tab, bs, block=block, vocab=vocab,
+                                  quant=quant)
+
+
+def tp_greedy_decode(hidden, table, mesh, *, bias=None, block: int = 8192,
+                     quant: str = "off"):
+    """Decode-shaped :func:`greedy_decode` over a ``model``-sharded
+    vocab table: ``argmax_v(hidden @ table.T + bias)`` with the table
+    resident in V/n shards and (hidden-chunk, argmax) bundles rotating
+    the ring — the standalone form of the serving engine's TP head
+    (which drives :func:`tp_greedy_decode_local` inside its fused
+    decode region instead).
+
+    Args:
+      hidden: ``(S, E)`` decode-shaped final hidden states — one row
+        per slot. ``S`` is padded internally to ring granularity and
+        the output sliced back.
+      table: ``(V, E)`` tied embedding/output table (replicated or
+        vocab-sharded; the region specs consume it in place).
+      mesh: mesh with a live ``model`` axis
+        (``parallel/collective_matmul.validate_tp_mesh``).
+      bias: optional ``(V,)`` output bias.
+      block: vocab tile width (clamped to the shard size).
+      quant: ``off | int8 | fp8`` — quantize the rotating hidden wire
+        (``ops/quant.py``); ``off`` is bit-identical to the dense head.
+
+    Returns ``(S,)`` int32 argmax ids; the :func:`_argmax_step`
+    tie-break-to-lowest-id invariant holds across shard visit order.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.collective_matmul import validate_tp_mesh
+    from ..parallel.shard_map_compat import shard_map
+    from ..runtime.context import MODEL_AXIS
+
+    validate_tp_mesh(mesh)
+    n = mesh.shape[MODEL_AXIS]
+    vocab, _ = table.shape
+    block, vs, pad_v = tp_head_geometry(vocab, n, block)
+    if bias is None:
+        bias = jnp.zeros((vocab,), jnp.float32)
+    if pad_v:
+        table = jnp.pad(table, ((0, pad_v), (0, 0)))
+        bias = jnp.pad(bias, (0, pad_v))
+    hidden_p, s_real = _tp_pad_seq(hidden, n, axis=0)
+
+    def local(h, tab, bs):
+        return tp_greedy_decode_local(h, tab, bs, block=block,
+                                      vocab=vocab, quant=quant)
+
+    best = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(MODEL_AXIS, None), P(MODEL_AXIS, None), P(MODEL_AXIS)),
+        out_specs=P(MODEL_AXIS), check_vma=False,
+    )(hidden_p, table, bias)
+    return best[:s_real]

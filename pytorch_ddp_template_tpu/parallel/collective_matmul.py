@@ -759,6 +759,36 @@ def tp_wire_bytes_per_step(*, batch: int, seq: int, embed: int,
     return {"stack": int(stack), "head": int(head)}
 
 
+def tp_decode_wire_bytes_per_step(*, slots: int, embed: int,
+                                  num_layers: int, n: int,
+                                  head: bool = True, itemsize: float = 4,
+                                  quant: str = "off") -> int:
+    """Model-axis TP bytes on the wire for ONE serving decode step —
+    the forward-only slice of :func:`tp_wire_bytes_per_step` with slots
+    as the ring's sequence axis (``serve/model.tp_decode_forward``):
+    :data:`STACK_RINGS_FWD` payload streams per layer, each rotating
+    ``(n-1)`` chunks of ``slots/n * embed`` per participant, plus the
+    rotating-argmax head bundle (hidden chunk + running (best_v f32,
+    best_i i32) per lane) when ``head`` is set. No backward streams —
+    serving never takes a gradient, so the custom_vjp rings never run.
+
+    ``quant``: under ``int8``/``fp8`` both the stack chunks and the
+    head's hidden cargo ride the narrow wire (1-byte payload + per-row
+    f32 scales, the 4/E overhead); the argmax stats stay wide — they
+    are 8 bytes per lane against ``embed`` per lane of hidden.
+    """
+    stack_itemsize = itemsize
+    if quant != "off":
+        from ..ops.quant import quant_itemsize, quant_scale_overhead
+
+        stack_itemsize = quant_itemsize(quant) + quant_scale_overhead(embed)
+    lanes = (n - 1) * slots  # chunk-rows rotated across the job per ring
+    total = num_layers * STACK_RINGS_FWD * int(lanes * embed * stack_itemsize)
+    if head:
+        total += int(lanes * (embed * stack_itemsize + 2 * 4))
+    return int(total)
+
+
 # -- HLO schedule evidence -------------------------------------------------
 
 def hlo_tp_evidence(hlo_text: str) -> dict[str, Any]:

@@ -15,6 +15,16 @@ shared-embedding draft proposes k tokens, the target verifies the
 window in one dispatch, greedy longest-prefix acceptance keeps the
 output token-for-token identical to plain greedy decode —
 ``ServeConfig(spec_k=..., draft_depth=...)`` turns it on.
+
+r21 adds tensor-parallel decode (``serve/model.py``): with
+``tp_overlap=True`` and a mesh carrying a live model axis, the decode
+step runs model-sharded end to end — fc1/fused-qkv as all-gather-matmul
+rings, fc2/out-proj as matmul-reduce-scatter rings (the r14 collective
+matmuls, forward-only), attention heads and the paged KV pool split over
+the model axis, and ``ops/lm_head.tp_greedy_decode`` sampling over
+resident vocab shards with the r17 quantized ring wire. Output stays
+token-for-token identical to single-replica greedy; ``describe_tp()``
+reports degree, per-step ring wire and per-shard KV residency.
 """
 
 from .engine import ServeConfig, ServeEngine  # noqa: F401

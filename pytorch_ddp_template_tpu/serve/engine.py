@@ -56,7 +56,8 @@ import numpy as np
 
 from ..utils import get_logger
 from .kv_cache import NULL_BLOCK, PagedKVCache
-from .model import decode_forward, prefill_forward, stacked_layers
+from .model import decode_forward, prefill_forward, stacked_layers, \
+    tp_decode_forward
 from .scheduler import ContinuousScheduler, Request
 
 log = get_logger(__name__)
@@ -116,35 +117,30 @@ class ServeConfig:
         return tuple(sorted(bks))
 
 
-def place_for_serving(params: dict, mesh) -> dict:
+def place_for_serving(params: dict, mesh, *, tp_head: bool = False) -> dict:
     """Model-shard the serving template over the mesh's ``model`` axis:
     attention heads (qkv kernel dim 2 / out kernel dim 1, with the
     leading stacked-layer axis) and the MLP hidden split; embeddings,
     norms and biases that span ``embed`` replicate. GSPMD partitions
     the jitted prefill/decode like any other program from these
-    placements."""
+    placements. The spec rule itself lives in
+    ``serve/model.serving_param_spec`` — ONE source shared with the
+    ``--tp_overlap`` ring decode's region specs, so placement and the
+    explicit-collective program can never disagree. ``tp_head=True``
+    (the TP ring engine) additionally shards the tied ``wte`` over
+    vocab; the caller pads the table to ring granularity first."""
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 
     from ..runtime.context import MODEL_AXIS
+    from .model import serving_param_spec
 
     n = mesh.shape.get(MODEL_AXIS, 1)
 
     def spec(path) -> P:
-        keys = [getattr(k, "key", getattr(k, "name", str(k))) for k in path]
-        if n > 1 and "layers" in keys:
-            name, field = keys[-2], keys[-1]
-            if name in ("query", "key", "value"):
-                return (P(None, None, MODEL_AXIS, None)
-                        if field == "kernel" else P(None, MODEL_AXIS, None))
-            if name == "out" and field == "kernel":
-                return P(None, MODEL_AXIS, None, None)
-            if name == "fc1":
-                return (P(None, None, MODEL_AXIS)
-                        if field == "kernel" else P(None, MODEL_AXIS))
-            if name == "fc2" and field == "kernel":
-                return P(None, MODEL_AXIS, None)
-        return P()
+        if n <= 1:
+            return P()
+        return serving_param_spec(path, tp_head=tp_head)
 
     return jax.tree_util.tree_map_with_path(
         lambda path, leaf: jax.device_put(
@@ -159,7 +155,7 @@ class ServeEngine:
                  *, mesh=None, goodput=None, status=None,
                  draft_params: dict | None = None):
         self.cfg = cfg or ServeConfig()
-        self._validate_model(model)
+        tp_live = self._validate_model(model, mesh)
         from ..ops.lm_head import SAMPLING_POLICIES
 
         if self.cfg.sampling not in SAMPLING_POLICIES:
@@ -202,14 +198,53 @@ class ServeEngine:
         params = nn.meta.unbox(params)  # fresh inits carry logical boxes
         params = convert_tree_layout(params, "scanned", strict=False)
         stacked_layers(params)  # validates the layout, refusal named
+        #: TP ring decode degree (1 = the plain/GSPMD path)
+        self._tp = 1
+        self._vocab = model.vocab_size
+        self._quant = "off"
         if mesh is not None:
             from ..runtime.context import MODEL_AXIS
 
-            if model.num_heads % mesh.shape.get(MODEL_AXIS, 1):
+            n_model = mesh.shape.get(MODEL_AXIS, 1)
+            if model.num_heads % n_model:
                 raise ValueError(
                     f"num_heads {model.num_heads} not divisible by the "
-                    f"model axis ({mesh.shape.get(MODEL_AXIS, 1)})")
-            params = place_for_serving(params, mesh)
+                    f"model axis ({n_model})")
+            if tp_live:
+                import os
+
+                from ..ops.lm_head import tp_head_geometry
+
+                if model.mlp_dim % n_model:
+                    raise ValueError(
+                        f"mlp_dim {model.mlp_dim} not divisible by the "
+                        f"model axis ({n_model}) — the fc1/fc2 rings "
+                        "shard the MLP hidden")
+                if self.cfg.max_slots % n_model:
+                    raise ValueError(
+                        f"TP decode shards the {self.cfg.max_slots} slot "
+                        f"lanes over the model axis ({n_model}); set "
+                        "max_slots to a multiple of it (scrap slots are "
+                        "cheap — they decode into the null block)")
+                if os.environ.get("PAGED_IMPL", "xla") == "pallas":
+                    raise ValueError(
+                        "TP serving runs the xla gather decode path "
+                        "only (the Pallas page walk is not validated "
+                        "under the sharded region); unset "
+                        "PAGED_IMPL=pallas")
+                self._tp = n_model
+                self._quant = getattr(model, "quant_compute", "off")
+                # pad the tied table ONCE to ring granularity: the
+                # vocab-parallel embed and the rotating-argmax head
+                # both consume resident (V/n)-row shards of it
+                _, vs, pad_v = tp_head_geometry(
+                    self._vocab, n_model, self.cfg.vocab_block)
+                if pad_v:
+                    params = dict(params)
+                    params["wte"] = dict(params["wte"])
+                    params["wte"]["embedding"] = jnp.pad(
+                        params["wte"]["embedding"], ((0, pad_v), (0, 0)))
+            params = place_for_serving(params, mesh, tp_head=tp_live)
         self.params = params
         self.kv = PagedKVCache(
             num_layers=model.num_layers, num_heads=model.num_heads,
@@ -218,17 +253,10 @@ class ServeEngine:
             kv_quant=self.cfg.kv_quant)
         if mesh is not None:
             from jax.sharding import NamedSharding
-            from jax.sharding import PartitionSpec as P
 
-            from ..runtime.context import MODEL_AXIS
-
-            kv_spec = NamedSharding(
-                mesh, P(None, None, None, MODEL_AXIS, None))
-            sc_spec = NamedSharding(
-                mesh, P(None, None, None, MODEL_AXIS, None))
+            kv_spec = NamedSharding(mesh, self.kv.head_sharding_spec())
             self.kv.pool = {
-                k: jax.device_put(v, sc_spec if k.endswith("_scale")
-                                  else kv_spec)
+                k: jax.device_put(v, kv_spec)
                 for k, v in self.kv.pool.items()}
         self.max_blocks = self.cfg.max_model_len // self.cfg.block_size
         self.scheduler = ContinuousScheduler(
@@ -262,7 +290,8 @@ class ServeEngine:
                 draft = make_draft_params(self.params, self.cfg.draft_depth)
                 depth = self.cfg.draft_depth
             if mesh is not None:
-                draft = place_for_serving(draft, mesh)
+                draft = place_for_serving(draft, mesh,
+                                          tp_head=self._tp > 1)
             self._spec = SpecRunner(self, draft, depth)
             log.info("speculative decoding on", {
                 "spec_k": self.cfg.spec_k, "draft_depth": depth,
@@ -281,26 +310,90 @@ class ServeEngine:
         self._t0 = time.perf_counter()
         self._prefill_s = 0.0
         self._decode_s = 0.0
+        if self._tp > 1:
+            log.info("serve_tp", self.describe_tp())
 
     @staticmethod
-    def _validate_model(model) -> None:
-        for flag in ("moe_experts", "tp_overlap", "fsdp_overlap",
-                     "ddp_overlap"):
+    def _validate_model(model, mesh) -> bool:
+        """The refusal matrix, with intent per flag. Returns True when
+        the ``--tp_overlap`` ring decode path is live: the model asks
+        for it AND the mesh carries a model axis > 1. Every refused
+        template names its own reason — "unsupported flag" tells an
+        operator nothing about what to change."""
+        from ..runtime.context import MODEL_AXIS
+
+        n = (mesh.shape.get(MODEL_AXIS, 1) if mesh is not None else 1)
+        tp = bool(getattr(model, "tp_overlap", False))
+        refusals = {
+            "moe_experts": (
+                "expert-parallel FFNs have no serving path yet (the "
+                "dispatch/combine all-to-alls would sit inside the "
+                "decode scan); serve the dense twin of the checkpoint"),
+            "fsdp_overlap": (
+                "serving holds no gradients or optimizer state, so "
+                "there is nothing to shard-and-overlap; params place "
+                "whole (or model-sharded) via place_for_serving"),
+            "ddp_overlap": (
+                "decode has no gradient all-reduce to overlap; "
+                "data-parallel serving is N engines behind one "
+                "scheduler, not one engine on a data axis"),
+            "pipe_stages": (
+                "pipelined templates have no serving path (the slot "
+                "loop's stage hand-offs assume a training microbatch "
+                "stream); restack the checkpoint through the r18 "
+                "layout converter and serve it flat"),
+        }
+        for flag, why in refusals.items():
             if getattr(model, flag, 0):
                 raise ValueError(
-                    f"serving template does not support {flag} (the "
-                    "engine runs the plain GSPMD math; model sharding "
-                    "comes from param placements) — export the "
-                    "checkpoint and serve it with the default template")
-        if getattr(model, "quant_compute", "off") != "off":
+                    f"serving template does not support {flag}: {why}")
+        if tp and n <= 1:
             raise ValueError(
-                "serving with --quant_compute weights is not wired yet "
-                "(the serve forward runs the master weights); kv_quant "
-                "int8 covers the cache side")
+                "--tp_overlap serving needs a mesh with a live model "
+                f"axis (got {'no mesh' if mesh is None else f'model axis {n}'}"
+                "): the ring collective matmuls and the rotating-argmax "
+                "head shard over it — pass a data×model mesh, or drop "
+                "tp_overlap to serve single-replica")
+        if getattr(model, "quant_compute", "off") != "off" and not tp:
+            raise ValueError(
+                "serving with --quant_compute weights rides the TP ring "
+                "wire only (tp_overlap on a model-axis mesh quantizes "
+                "the rotating chunks, r17 path); the plain template "
+                "runs the master weights — kv_quant int8 covers the "
+                "cache side")
         if getattr(model, "attn_impl", "auto") in ("ring", "ulysses"):
             raise ValueError(
                 "context-parallel attention has no serving path yet; "
                 "serve with attn_impl='auto'")
+        return tp
+
+    def describe_tp(self) -> dict[str, Any]:
+        """The ``serve_tp`` startup/describe block: tp degree, per-step
+        decode ring wire (wide vs the r17 quantized wire) and the KV
+        pool's per-shard residency — what an operator needs to size the
+        ICI budget and the HBM split before any traffic arrives. The
+        same numbers export as ``tpuddp_serve_tp_*`` gauges via
+        :meth:`stats`."""
+        from ..parallel.collective_matmul import tp_decode_wire_bytes_per_step
+
+        n = self._tp
+        embed = self.model.num_heads * self.model.head_dim
+        wide = tp_decode_wire_bytes_per_step(
+            slots=self.cfg.max_slots, embed=embed,
+            num_layers=self.model.num_layers, n=n)
+        quant = tp_decode_wire_bytes_per_step(
+            slots=self.cfg.max_slots, embed=embed,
+            num_layers=self.model.num_layers, n=n,
+            quant=self._quant if self._quant != "off" else "int8")
+        return {
+            "serve_tp_degree": n,
+            "serve_tp_ring_wire_mb_per_step_wide": wide / 1e6,
+            "serve_tp_ring_wire_mb_per_step_quant": quant / 1e6,
+            "serve_tp_ring_wire_mb_per_step": (
+                (quant if self._quant != "off" else wide) / 1e6),
+            "serve_tp_kv_pool_bytes_per_shard": self.kv.pool_bytes(
+                model_shards=n),
+        }
 
     # -- jitted math -------------------------------------------------------
     def _prefill_math(self, params, pool, ids, length, block_ids):
@@ -333,13 +426,27 @@ class ServeEngine:
         from ..ops.lm_head import sample_tokens
 
         h_last = jnp.take(hidden[0], length - 1, axis=0)  # (E,)
+        # vocab= masks the ring-granularity pad rows of a TP-placed
+        # table (a no-op for the unpadded single-replica table)
         nxt = sample_tokens(h_last[None], params["wte"]["embedding"],
                             policy=self.cfg.sampling,
-                            block=self.cfg.vocab_block)[0]
+                            block=self.cfg.vocab_block,
+                            vocab=self._vocab)[0]
         return nxt, pool
 
     def _decode_math(self, params, pool, tokens, positions, tables,
                      ctx_lens, write_blocks, write_offsets):
+        if self._tp > 1:
+            # the TP ring program samples inside its one shard_map
+            # region (serve/model.tp_decode_forward) — hidden never
+            # leaves the shards
+            return tp_decode_forward(
+                params, pool, tokens, positions, tables, ctx_lens,
+                write_blocks, write_offsets, mesh=self.mesh,
+                dtype=self.dtype, vocab=self._vocab,
+                kv_quant=self.cfg.kv_quant, quant=self._quant,
+                policy=self.cfg.sampling,
+                vocab_block=self.cfg.vocab_block)
         hidden, pool = decode_forward(
             params, pool, tokens, positions, tables, ctx_lens,
             write_blocks, write_offsets, dtype=self.dtype,
@@ -560,6 +667,10 @@ class ServeEngine:
             rec["serve_per_token_ms_mean"] = slo["per_token_s_mean"] * 1e3
         if self._spec is not None:
             rec.update(self._spec.stats_fields(self.scheduler.running))
+        if self._tp > 1:
+            # flat numeric fields → tpuddp_serve_tp_* gauges for free
+            # (the /metrics sweep exports every number on kind "serve")
+            rec.update(self.describe_tp())
         return rec
 
     def serve_state(self) -> dict[str, Any]:

@@ -120,6 +120,23 @@ class PagedKVCache:
         self.free_count = 0
         self.high_water_blocks = 0
 
+    # -- placement ---------------------------------------------------------
+    @staticmethod
+    def head_sharding_spec():
+        """``PartitionSpec`` sharding the pool's HEAD axis over the
+        ``model`` mesh axis — ``(L, N, B, H, D)`` dim 3, and dim 3 of
+        the ``(L, N, B, H, 1)`` scale leaves alike (int8 scales are
+        per-(token, head), so they shard with their heads). The one
+        pool-placement rule: the engine's GSPMD path device_puts with
+        it, and the TP ring decode's region in_specs reuse it — block
+        tables and the free list stay host-side and replicated, so the
+        allocator never learns the mesh exists."""
+        from jax.sharding import PartitionSpec as P
+
+        from ..runtime.context import MODEL_AXIS
+
+        return P(None, None, None, MODEL_AXIS, None)
+
     # -- byte accounting ---------------------------------------------------
     def bytes_per_token(self) -> float:
         """Resident KV bytes one token costs across all layers — the
@@ -130,6 +147,14 @@ class PagedKVCache:
             return self.num_layers * (per * 1 + 2 * self.num_heads * 4)
         return self.num_layers * per * float(
             jnp.dtype(self.pool["k"].dtype).itemsize)
+
+    def pool_bytes(self, *, model_shards: int = 1) -> int:
+        """Resident pool bytes per model shard: the whole pool at
+        ``model_shards=1``; under :meth:`head_sharding_spec` each shard
+        holds ``H / model_shards`` heads of every leaf."""
+        total = sum(int(v.size) * jnp.dtype(v.dtype).itemsize
+                    for v in self.pool.values())
+        return total // max(model_shards, 1)
 
     # -- allocation --------------------------------------------------------
     def blocks_needed(self, n_tokens: int) -> int:

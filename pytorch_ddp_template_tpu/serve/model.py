@@ -19,10 +19,15 @@ position's KV every token). This module re-expresses the
   pool's layer axis as scan xs/ys — layer ``l``'s blocks are read and
   written inside iteration ``l``, never gathered whole.
 
-Supported template: the plain GSPMD path (no tp_overlap/MoE/pipe —
-the engine refuses those with intent; model sharding comes from the
-params'/pool's NamedShardings, GSPMD partitions these functions like
-any other jitted program).
+Supported templates: the plain GSPMD path (model sharding comes from
+the params'/pool's NamedShardings, GSPMD partitions these functions
+like any other jitted program), and — since r21 — the ``--tp_overlap``
+ring path: :func:`tp_decode_forward` re-expresses the decode step as
+explicit all-gather-matmul / matmul-reduce-scatter rings under ONE
+``shard_map`` region (slots play the ring's sequence axis, attention
+heads and the paged pool shard over ``model``, and the LM head is the
+rotating-argmax ring). MoE/pipe templates are still refused by the
+engine with intent.
 """
 
 from __future__ import annotations
@@ -223,3 +228,213 @@ def verify_forward(params: dict, pool: dict, token_ids: jax.Array,
         flat(context_lens), flat(write_blocks), flat(write_offsets),
         dtype=dtype, kv_quant=kv_quant)
     return hidden.reshape(s, k, -1), pool
+
+
+# -- TP ring decode (r21): the decode step as explicit collective rings ----
+#
+# Decode activations are one token per slot — ``(S, E)`` — so the slot
+# axis plays the role the sequence axis plays in training's decomposed
+# stack (``parallel/collective_matmul.py``): each shard holds its
+# ``S/n`` home slots, the fused-qkv/fc1 column matmuls all-gather the
+# slot chunks around the ring while producing head-/mlp-sharded
+# activations for ALL slots, paged attention runs on the local H/n head
+# shard of the pool, and the out/fc2 row matmuls reduce-scatter back to
+# the home chunk. Everything — embed, the layer scan, the rotating-
+# argmax LM head — lives in ONE ``shard_map`` region, so the engine's
+# compile contract is unchanged: the TP decode step is still exactly
+# one jitted program. Forward-only: the training kernels' custom_vjp
+# never runs (no grad is taken through serving).
+
+
+def serving_param_spec(path, *, tp_head: bool = False):
+    """``PartitionSpec`` for one serving-template leaf — the ONE spec
+    rule shared by ``engine.place_for_serving`` (placement) and
+    :func:`tp_decode_forward` (the region's in_specs): attention heads
+    (qkv kernel dim 2 / out kernel dim 1, behind the stacked-layer
+    axis) and the MLP hidden split over ``model``; embeddings, norms
+    and embed-spanning biases replicate. ``tp_head=True`` additionally
+    shards the tied ``wte`` table over vocab (rows pre-padded to
+    ``ops/lm_head.tp_head_geometry``) — the resident shards the
+    rotating-argmax head and the vocab-parallel embed lookup consume.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..runtime.context import MODEL_AXIS
+
+    keys = [getattr(k, "key", getattr(k, "name", str(k))) for k in path]
+    if "layers" in keys:
+        name, field = keys[-2], keys[-1]
+        if name in ("query", "key", "value"):
+            return (P(None, None, MODEL_AXIS, None)
+                    if field == "kernel" else P(None, MODEL_AXIS, None))
+        if name == "out" and field == "kernel":
+            return P(None, MODEL_AXIS, None, None)
+        if name == "fc1":
+            return (P(None, None, MODEL_AXIS)
+                    if field == "kernel" else P(None, MODEL_AXIS))
+        if name == "fc2" and field == "kernel":
+            return P(None, MODEL_AXIS, None)
+    if tp_head and keys[-2:] == ["wte", "embedding"]:
+        return P(MODEL_AXIS, None)
+    return P()
+
+
+def tp_decode_forward(params: dict, pool: dict, token_ids: jax.Array,
+                      positions: jax.Array, tables: jax.Array,
+                      context_lens: jax.Array, write_blocks: jax.Array,
+                      write_offsets: jax.Array, *, mesh, dtype, vocab: int,
+                      kv_quant: str = "off", quant: str = "off",
+                      policy: str = "greedy", vocab_block: int = 8192):
+    """One model-sharded decode step for ``S`` slots: the ring twin of
+    :func:`decode_forward` fused with the rotating-argmax LM head.
+
+    Per shard, per layer: home slot chunk ``(S/n, E)`` → fused-qkv
+    all-gather-matmul ring → q/k/v ``(S, H/n, D)`` for ALL slots →
+    KV write + paged attention on the local head shard of the pool →
+    out-projection matmul-reduce-scatter ring → home chunk; same
+    column/gelu/row pattern for fc1/fc2. The embed lookup is
+    vocab-parallel (each shard contributes the rows its ``wte`` shard
+    owns; one tiny ``psum``), and the final hidden chunk feeds
+    ``ops/lm_head.tp_sample_tokens_local`` directly — the logits row
+    never exists and no shard ever holds more than ``V/n`` table rows.
+
+    Requirements (validated by the engine with named refusals):
+    ``S % n == 0`` (slots are the ring axis; scrap slots pad),
+    ``num_heads % n == 0``, ``mlp_dim % n == 0``, and the tied table
+    padded to ``tp_head_geometry`` rows. ``quant`` rides the r17 narrow
+    wire through the stack rings and the head bundle. Block tables,
+    context lens and write targets stay host-shaped and replicated —
+    the allocator knows nothing about the mesh.
+
+    Returns ``(next_tokens (S,), pool)`` — tokens, not hidden: sampling
+    happens inside the region (the decode and verify paths both end in
+    the head ring, so hidden never leaves the shards).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.lm_head import tp_head_geometry, tp_sample_tokens_local
+    from ..parallel.collective_matmul import (tp_column_dense_local,
+                                              tp_row_dense_local,
+                                              validate_tp_mesh)
+    from ..parallel.shard_map_compat import shard_map
+    from ..runtime.context import MODEL_AXIS
+
+    validate_tp_mesh(mesh)
+    n = mesh.shape[MODEL_AXIS]
+    s = token_ids.shape[0]
+    if s % n:
+        raise ValueError(
+            f"TP decode shards the {s} slot lanes over the model axis "
+            f"({n}); max_slots must be a multiple of it")
+    block, vs, pad_v = tp_head_geometry(vocab, n, vocab_block)
+    rows = params["wte"]["embedding"].shape[0]
+    if rows != vocab + pad_v:
+        raise ValueError(
+            f"TP decode needs the tied table padded to ring granularity "
+            f"({vocab + pad_v} rows for vocab {vocab} on a {n}-way ring), "
+            f"got {rows} — place params through the engine (it pads once "
+            "at placement)")
+
+    def local(p, pool_l, ids, pos_c, tabs, ctx, wb, wo):
+        wte = p["wte"]["embedding"]              # (vs, E) vocab shard
+        me = lax.axis_index(MODEL_AXIS)
+        off = me * vs
+        # vocab-parallel embed: ids stay REPLICATED (sharding them would
+        # let the psum mix different slots' rows) — each shard
+        # contributes the rows its vocab shard owns for ALL slots, one
+        # (S, E) psum assembles the lookup, and the home chunk is
+        # sliced out for the rings.
+        hit = (ids >= off) & (ids < off + vs)
+        rows = jnp.take(wte.astype(dtype),
+                        jnp.clip(ids - off, 0, vs - 1), axis=0)
+        x = lax.psum(rows * hit[:, None].astype(dtype), MODEL_AXIS)
+        t = ids.shape[0] // n
+        x = lax.dynamic_slice_in_dim(x, me * t, t, axis=0)
+        x = x + jnp.take(p["wpe"]["embedding"].astype(dtype), pos_c,
+                         axis=0)                 # (S/n, E) home chunk
+
+        def body(carry, layer):
+            lp, pool_l = layer
+            h = layer_norm(carry, lp["ln_attn"]).astype(dtype)
+            q, k, v = tp_column_dense_local(
+                h[None],
+                [lp["attention"]["query"]["kernel"].astype(dtype),
+                 lp["attention"]["key"]["kernel"].astype(dtype),
+                 lp["attention"]["value"]["kernel"].astype(dtype)],
+                [lp["attention"]["query"]["bias"].astype(dtype),
+                 lp["attention"]["key"]["bias"].astype(dtype),
+                 lp["attention"]["value"]["bias"].astype(dtype)],
+                quant=quant)                     # each (1, S, H/n, D)
+            q, k, v = q[0], k[0], v[0]           # ALL slots, local heads
+            pool_l = _write_pool(pool_l, "k", k, wb, wo, kv_quant)
+            pool_l = _write_pool(pool_l, "v", v, wb, wo, kv_quant)
+            a = paged_attention(
+                q, pool_l["k"], pool_l["v"], tabs, ctx,
+                k_scale=pool_l.get("k_scale"),
+                v_scale=pool_l.get("v_scale"))   # (S, H/n, D)
+            a = tp_row_dense_local(
+                a[None], lp["attention"]["out"]["kernel"].astype(dtype),
+                lp["attention"]["out"]["bias"].astype(dtype),
+                quant=quant)[0]                  # (S/n, E) home chunk
+            y = carry + a.astype(dtype)
+            h = layer_norm(y, lp["ln_mlp"]).astype(dtype)
+            h = tp_column_dense_local(
+                h[None], [lp["mlp"]["fc1"]["kernel"].astype(dtype)],
+                [lp["mlp"]["fc1"]["bias"].astype(dtype)],
+                quant=quant)[0]                  # (1, S, mlp/n)
+            h = jax.nn.gelu(h.astype(dtype))
+            h = tp_row_dense_local(
+                h, lp["mlp"]["fc2"]["kernel"].astype(dtype),
+                lp["mlp"]["fc2"]["bias"].astype(dtype),
+                quant=quant)[0]                  # (S/n, E) home chunk
+            return y + h.astype(dtype), pool_l
+
+        x, pool_out = lax.scan(body, x, (stacked_layers(p), pool_l))
+        hidden = layer_norm(x, p["final_ln"]).astype(dtype)
+        nxt = tp_sample_tokens_local(
+            hidden, wte, jnp.zeros((vs,), jnp.float32), policy=policy,
+            block=block, vocab=vocab, quant=quant)
+        # tokens leave REPLICATED (S ints — one tiny all-gather): the
+        # spec draft chains each step's output into the next step's
+        # input, and a sharded output would hash as a new jit signature
+        # against the host-built first step (breaking the one-program-
+        # per-role pin)
+        return lax.all_gather(nxt, MODEL_AXIS, tiled=True), pool_out
+
+    p_specs = jax.tree_util.tree_map_with_path(
+        lambda path, _: serving_param_spec(path, tp_head=True), params)
+    pool_spec = {k: P(None, None, None, MODEL_AXIS, None) for k in pool}
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(p_specs, pool_spec, P(), P(MODEL_AXIS),
+                  P(), P(), P(), P()),
+        out_specs=(P(), pool_spec), check_vma=False,
+    )(params, pool, token_ids, positions, tables, context_lens,
+      write_blocks, write_offsets)
+
+
+def tp_verify_forward(params: dict, pool: dict, token_ids: jax.Array,
+                      positions: jax.Array, tables: jax.Array,
+                      context_lens: jax.Array, write_blocks: jax.Array,
+                      write_offsets: jax.Array, *, mesh, dtype, vocab: int,
+                      kv_quant: str = "off", quant: str = "off",
+                      policy: str = "greedy", vocab_block: int = 8192):
+    """:func:`verify_forward` on the TP ring path: the ``(S, K)``
+    draft windows flatten into ``S*K`` staggered lanes exactly as the
+    single-replica path does (``S % n == 0`` keeps the lane count ring-
+    divisible), ride :func:`tp_decode_forward`, and the per-lane argmax
+    comes back ``(S, K)`` — the spec verify dispatch IS the sharded
+    decode program, so spec × tp parity holds by construction.
+
+    Returns ``(next_tokens (S, K), pool)``."""
+    s, k = token_ids.shape
+
+    def flat(a):
+        return a.reshape((s * k,) + a.shape[2:])
+
+    nxt, pool = tp_decode_forward(
+        params, pool, flat(token_ids), flat(positions), flat(tables),
+        flat(context_lens), flat(write_blocks), flat(write_offsets),
+        mesh=mesh, dtype=dtype, vocab=vocab, kv_quant=kv_quant,
+        quant=quant, policy=policy, vocab_block=vocab_block)
+    return nxt.reshape(s, k), pool

@@ -66,7 +66,7 @@ import numpy as np
 from ..utils import get_logger
 from .kv_cache import NULL_BLOCK, quantize_kv
 from .model import decode_forward, prefill_forward, stacked_layers, \
-    verify_forward
+    tp_decode_forward, tp_verify_forward, verify_forward
 from .scheduler import Request
 
 log = get_logger(__name__)
@@ -266,6 +266,18 @@ class SpecRunner:
 
         eng = self.engine
         sub = self._sub_pool(pool)
+        if eng._tp > 1:
+            # TP engine (r21): the draft rides the SAME ring-sharded
+            # decode program shape as the target — depth-sliced pool,
+            # identical per-shard head/vocab geometry (the draft shares
+            # the target's padded table by reference)
+            nxt, sub = tp_decode_forward(
+                params, sub, tokens, positions, tables, ctx_lens,
+                write_blocks, write_offsets, mesh=eng.mesh,
+                dtype=eng.dtype, vocab=eng._vocab,
+                kv_quant=eng.cfg.kv_quant, quant=eng._quant,
+                policy=eng.cfg.sampling, vocab_block=eng.cfg.vocab_block)
+            return nxt, self._merge_pool(pool, sub)
         hidden, sub = decode_forward(
             params, sub, tokens, positions, tables, ctx_lens,
             write_blocks, write_offsets, dtype=eng.dtype,
@@ -280,6 +292,16 @@ class SpecRunner:
         from ..ops.lm_head import sample_tokens
 
         eng = self.engine
+        if eng._tp > 1:
+            # verify lanes ride the sharded program too (the lossless
+            # pin is against TP greedy, so draft/verify/plain must all
+            # share one math path)
+            return tp_verify_forward(
+                params, pool, tokens, positions, tables, ctx_lens,
+                write_blocks, write_offsets, mesh=eng.mesh,
+                dtype=eng.dtype, vocab=eng._vocab,
+                kv_quant=eng.cfg.kv_quant, quant=eng._quant,
+                policy=eng.cfg.sampling, vocab_block=eng.cfg.vocab_block)
         hidden, pool = verify_forward(
             params, pool, tokens, positions, tables, ctx_lens,
             write_blocks, write_offsets, dtype=eng.dtype,
@@ -341,6 +363,14 @@ class SpecRunner:
         # -- draft: k_round dispatches, token chain stays on device
         t0 = time.perf_counter()
         cur = jnp.asarray(feed)
+        if eng._tp > 1:
+            # the TP draft program emits REPLICATED tokens; the chain's
+            # first feed must carry the same sharding or the second
+            # dispatch hashes as a new program (breaking the 2-program
+            # pin)
+            from jax.sharding import NamedSharding, PartitionSpec
+            cur = jax.device_put(
+                cur, NamedSharding(eng.mesh, PartitionSpec()))
         drafts = []
         for t in range(k_round):
             positions = np.zeros((s_lanes,), np.int32)

@@ -29,6 +29,13 @@
 #                                               # win, lossless re-check, the
 #                                               # two-program pin) and gate it
 #                                               # vs the committed spec record
+#   RUN_SERVE_TP=1 bash tools/ci_bench_check.sh # r21: run BENCH_MODE=serve_tp
+#                                               # fresh (CPU, 2 virtual
+#                                               # devices: token-for-token
+#                                               # parity vs single replica,
+#                                               # the one-program pin, HLO
+#                                               # ring evidence) and gate it
+#                                               # vs the committed record
 #
 # Exit codes are bench_diff's: 0 in-band, 1 drift, 2 no overlap/usage
 # (an empty comparison must not read as green). Output is the github
@@ -43,7 +50,7 @@ TOLERANCE=${TOLERANCE:-0.25}
 # gates both records (a later block overwriting CANDIDATE would silently
 # discard the earlier run)
 if [ "${RUN_SERVE:-0}" = "1" ] || [ "${RUN_ELASTIC:-0}" = "1" ] \
-    || [ "${RUN_SPEC:-0}" = "1" ]; then
+    || [ "${RUN_SPEC:-0}" = "1" ] || [ "${RUN_SERVE_TP:-0}" = "1" ]; then
   FRESH_DIR=$(mktemp -d)
   CANDIDATE=$FRESH_DIR
 fi
@@ -61,6 +68,14 @@ if [ "${RUN_SPEC:-0}" = "1" ]; then
   # re-checking losslessness inside the run
   BENCH_CPU=${BENCH_CPU:-1} BENCH_MODE=spec \
     timeout 1200 python bench.py | tee "$FRESH_DIR/spec_fresh.jsonl"
+fi
+
+if [ "${RUN_SERVE_TP:-0}" = "1" ]; then
+  # the tp leg needs a model:2 axis — two virtual CPU devices; parity,
+  # the compile pin and the ring-evidence AOT compile ride one run
+  BENCH_CPU=${BENCH_CPU:-1} BENCH_CPU_DEVICES=${BENCH_CPU_DEVICES:-2} \
+    BENCH_MODE=serve_tp \
+    timeout 1200 python bench.py | tee "$FRESH_DIR/serve_tp_fresh.jsonl"
 fi
 
 if [ "${RUN_ELASTIC:-0}" = "1" ]; then

@@ -19,10 +19,10 @@
 # bench mode appends one JSON line to its round's records file.
 # Usage: bash tools/tpu_followup.sh <round>   (requires the axon tunnel)
 set -u
-ROUND=${1:?usage: tpu_followup.sh <round: 4..20>}
+ROUND=${1:?usage: tpu_followup.sh <round: 4..21>}
 case "$ROUND" in (*[!0-9]*|'') echo "round must be a number, got '$ROUND'" >&2; exit 2;; esac
-if [ "$ROUND" -lt 4 ] || [ "$ROUND" -gt 20 ]; then
-  echo "unknown round $ROUND (expected 4..20)" >&2; exit 2
+if [ "$ROUND" -lt 4 ] || [ "$ROUND" -gt 21 ]; then
+  echo "unknown round $ROUND (expected 4..21)" >&2; exit 2
 fi
 cd "$(dirname "$0")/.."
 R=bench_records
@@ -362,6 +362,26 @@ legs_r20() {
   python tools/bench_diff.py "$R" "$R/spec_tpu_r20.jsonl" --format github \
     > "$R/bench_diff_tpu_r20.md" 2>>"$ERR" \
     || echo "bench_diff flagged drift (see bench_diff_tpu_r20.md)" >&2
+}
+
+legs_r21() {
+  # tensor-parallel decode: the BENCH_MODE=serve_tp legs on real chips.
+  # The CPU record (serve_tp_cpu_r21.jsonl) proves token-for-token
+  # parity, the one-program compile pin and HLO ring evidence; chips
+  # are needed for (a) the REAL tp-on vs tp-off tokens/sec pair — on
+  # CPU the ring pays ppermute cost for no memory-bandwidth win, on
+  # chip the sharded weight reads are the win decode actually wants
+  # (each record carries tokens_per_sec_tp/tokens_per_sec_single_replica
+  # from the same run), (b) the quantized ring wire at real ICI cost
+  # (the ablation row rides every invocation), and (c) the
+  # tpuddp_serve_tp_* gauges scraped from a chip-backed engine.
+  run serve_tp_pair serve_tp_tpu_r21.jsonl 1200 BENCH_MODE=serve_tp
+  run serve_tp_4way serve_tp_tpu_r21.jsonl 1200 BENCH_MODE=serve_tp \
+    BENCH_SERVE_TP=4 BENCH_SERVE_TP_SLOTS=8
+  run serve_plain   serve_tpu_r19.jsonl 1200 BENCH_MODE=serve
+  python tools/bench_diff.py "$R" "$R/serve_tp_tpu_r21.jsonl" --format github \
+    > "$R/bench_diff_tpu_r21.md" 2>>"$ERR" \
+    || echo "bench_diff flagged drift (see bench_diff_tpu_r21.md)" >&2
 }
 
 # -- the historical chain ---------------------------------------------------
