@@ -50,7 +50,7 @@ from pytorch_ddp_template_tpu.obs.attribution import (  # noqa: E402
     PEAK_FLOPS, cost_of,
 )
 
-MODE = os.environ.get("BENCH_MODE", "train")  # train | e2e | scaling | flash | compile | overlap | comms | tp | overlap3d | obs | perf | fleet | mem | pipe | quant | elastic | serve | spec | serve_tp
+MODE = os.environ.get("BENCH_MODE", "train")  # train | e2e | scaling | flash | compile | overlap | comms | tp | overlap3d | obs | perf | fleet | mem | pipe | pipe_compose | quant | elastic | serve | spec | serve_tp
 MODEL = os.environ.get("BENCH_MODEL", "resnet50")
 WARMUP_STEPS = int(os.environ.get("BENCH_WARMUP", "5"))
 TIMED_STEPS = int(os.environ.get("BENCH_STEPS", "30"))
@@ -73,7 +73,7 @@ def _emit(payload: dict) -> None:
 ABLATION_KEYS = ("remat", "fused_head", "dense_head", "flash_disabled",
                  "num_layers", "scan_layers", "ddp_overlap", "tp_overlap",
                  "fsdp_overlap", "quant_compute", "kv_quant", "paged_impl",
-                 "spec_k", "draft_depth", "tp_degree")
+                 "spec_k", "draft_depth", "tp_degree", "pipe_schedule")
 
 
 def _last_recorded(metric: str) -> dict | None:
@@ -2907,6 +2907,212 @@ def run_pipe() -> dict:
     }
 
 
+def run_pipe_compose() -> dict:
+    """4D-composition proof (round 22, parallel/pipeline.py): the 1f1b
+    slot loop composing with tensor parallelism (pipe×tp) and with
+    per-slot data-parallel grad reduces (pipe×ddp) through boundary-
+    hoisted collective waves — every compose collective at the slot-body
+    top level, NONE inside the work switch's branch computations.
+
+    Legs, sized for what THIS host can prove (a 1-core CPU time-slices
+    its 8 virtual devices, so wall tracks total work, not the lockstep
+    makespan — the real-chip ratios ride ``tools/tpu_followup.sh
+    legs_r22``):
+
+    - **parity**: loss + full param grads of ``--pipe_schedule 1f1b
+      --tp_overlap`` (mesh data×model:2×pipe:2) and ``--pipe_schedule
+      1f1b --ddp_overlap`` (mesh data×pipe:2) against sequential stage
+      execution (no pipeline, same init) — float32 tolerance, the same
+      bar the plain schedules hold in BENCH_MODE=pipe.
+    - **FLOPs-matched step ratio**: plain-1f1b vs composed step time on
+      the SAME mesh (min-of-alternating-reps). On this host the compose
+      waves are extra serialised work, so the ratio is a regression
+      tripwire (>= the band), not a speedup claim.
+    - **HLO slot-body evidence**: ``obs/hlo_report.pipe_evidence`` on
+      the compiled composed steps — boundary ppermutes compute-
+      independent AND ``branch_collectives == 0`` (the r22 invariant: a
+      collective inside a divergent switch branch is a deadlock on real
+      hardware, so the tripwire is load-bearing, not cosmetic).
+
+    Degenerate contract: fewer than 4 devices (no pipe×data mesh worth
+    scheduling) emits ``degenerate: true`` with value 0 (r8 convention);
+    pipe×tp additionally needs ``4 | n_devices`` for its
+    data×model:2×pipe:2 carve and is skipped (recorded null) when the
+    host cannot shape it.
+
+    Knobs: BENCH_MICRO (microbatches, default 4), BENCH_SEQ (128),
+    BENCH_BATCH (per data replica, default 16), BENCH_STEPS/
+    BENCH_WARMUP.
+    """
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_ddp_template_tpu.models.gpt_pipe import PipelinedGptTask
+    from pytorch_ddp_template_tpu.obs.hlo_report import pipe_evidence
+    from pytorch_ddp_template_tpu.runtime import make_mesh
+
+    n_micro = int(os.environ.get("BENCH_MICRO", "4"))
+    seq = int(os.environ.get("BENCH_SEQ", "128"))
+    per_replica = PER_DEVICE_BATCH or 16
+    devices = jax.devices()
+    metric = f"pipe_compose_step_ratio_m{n_micro}p2"
+    unit = "x_plain_1f1b_step_time"
+    if len(devices) < 4 or len(devices) % 2:
+        return {
+            "metric": metric, "value": 0.0, "unit": unit,
+            "vs_baseline": 0.0, "degenerate": True,
+            "n_devices": len(devices),
+            "note": f"{len(devices)} device(s) cannot carve a pipe:2 × "
+                    "data mesh; the real legs ride "
+                    "tools/tpu_followup.sh legs_r22",
+        }
+    n_stages = 2
+    vocab, heads, head_dim, mlp = 1024, 4, 32, 512
+    embed = heads * head_dim
+    can_tp = len(devices) % 4 == 0
+
+    def seq_loss_fn(task, ids, batch):
+        def seq_loss(p):
+            x = task._embed(p, jnp.asarray(ids))
+            flat = jax.tree.map(
+                lambda a: a.reshape(task.num_layers, *a.shape[2:]),
+                p["blocks"])
+            h = x
+            for i in range(task.num_layers):
+                layer = jax.tree.map(lambda a, i=i: a[i], flat)
+                h = task._block.apply({"params": layer}, h, None,
+                                      train=False)
+            hf = task._ln.apply({"params": p["final_ln"]},
+                                h.astype(jnp.float32))
+            logits = (hf.astype(task.dtype)
+                      @ p["wte"].T.astype(task.dtype)).astype(jnp.float32)
+            targets = jnp.asarray(ids)[:, 1:].astype(jnp.int32)
+            logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+            tlp = jnp.take_along_axis(
+                logp, targets[..., None], axis=-1)[..., 0]
+            return -tlp.sum() / (batch * (seq - 1))
+        return seq_loss
+
+    def leg(compose, mesh_spec):
+        mesh = make_mesh(mesh_spec, devices)
+        data_size = mesh.shape.get("data", 1)
+        batch = per_replica * data_size
+        kw = dict(vocab_size=vocab, seq_len=seq, num_layers=2 * n_stages,
+                  num_heads=heads, head_dim=head_dim, mlp_dim=mlp,
+                  n_micro=n_micro)
+        composed = PipelinedGptTask(
+            mesh, pipe_schedule="1f1b",
+            tp_overlap=(compose == "tp"),
+            ddp_overlap=(compose == "ddp"), **kw)
+        plain = PipelinedGptTask(mesh, pipe_schedule="1f1b", **kw)
+        rng = np.random.default_rng(0)
+        ids = np.asarray(rng.integers(0, vocab, (batch, seq)), np.int32)
+        ex = {"input_ids": ids}
+        params = nn.meta.unbox(
+            composed.init(jax.random.PRNGKey(1), ex))
+        params = params[0] if isinstance(params, tuple) else params
+
+        def task_loss(task):
+            def f(p):
+                total, _, _ = task.loss(p, {}, ex, None, train=True)
+                return total
+            return f
+
+        fn_comp = jax.jit(jax.value_and_grad(task_loss(composed)))
+        fn_plain = jax.jit(jax.value_and_grad(task_loss(plain)))
+        l_ref, g_ref = jax.jit(
+            jax.value_and_grad(seq_loss_fn(composed, ids, batch)))(params)
+        l_ref = float(l_ref)
+        g_ref = jax.device_get(g_ref)
+
+        l_c, g_c = fn_comp(params)
+        l_c = float(l_c)
+        g_c = jax.device_get(g_c)
+        worst = 0.0
+        for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_c)):
+            d = float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+            s = max(float(np.max(np.abs(np.asarray(a)))), 1e-6)
+            worst = max(worst, d / s)
+        assert worst < 5e-3, f"pipe×{compose} grad parity broke: {worst}"
+        assert abs(l_c - l_ref) < 1e-4 * max(abs(l_ref), 1.0), (
+            compose, l_c, l_ref)
+
+        # step ratio: plain vs composed on the same mesh, min of
+        # alternating reps
+        step_ms = {}
+        for fn in (fn_comp, fn_plain):  # warmup (compiled above)
+            for _ in range(max(WARMUP_STEPS - 1, 1)):
+                l, _ = fn(params)
+            float(l)
+        for rep in range(3):
+            for kind, fn in (("composed", fn_comp), ("plain", fn_plain)):
+                t0 = time.perf_counter()
+                for _ in range(TIMED_STEPS):
+                    l, g = fn(params)
+                float(l)
+                jax.block_until_ready(g)
+                ms = 1e3 * (time.perf_counter() - t0) / TIMED_STEPS
+                step_ms[kind] = min(step_ms.get(kind, ms), ms)
+        ratio = step_ms["plain"] / max(step_ms["composed"], 1e-9)
+
+        ev = pipe_evidence(fn_comp.lower(params).compile().as_text())
+        assert ev["pipe_sends_independent"], (compose, ev)
+        assert ev["branch_collectives_free"], (
+            f"pipe×{compose}: {ev['branch_collectives']} collective(s) "
+            "inside branch_computations — boundary hoisting broke")
+        return {
+            "mesh": mesh_spec,
+            "batch": batch,
+            "loss_seq_ref": l_ref,
+            "loss_composed": round(l_c, 6),
+            "parity_max_rel_grad": float(f"{worst:.3e}"),
+            "step_time_plain_ms": round(step_ms["plain"], 2),
+            "step_time_composed_ms": round(step_ms["composed"], 2),
+            "step_ratio_vs_plain": round(ratio, 3),
+            "hlo": {k: ev[k] for k in
+                    ("slot_bodies", "independent_send_bodies",
+                     "pipe_sends_independent", "conditional_count",
+                     "branch_computation_count", "branch_collectives",
+                     "branch_collectives_free")},
+        }
+
+    legs = {}
+    if can_tp:
+        legs["tp"] = leg("tp", f"data:{len(devices) // 4},model:2,pipe:2")
+    legs["ddp"] = leg("ddp", f"data:{len(devices) // 2},pipe:2")
+
+    # headline: the weakest same-mesh step ratio across the composed
+    # legs — a regression tripwire (the compose waves are serialised
+    # extra work on this time-sliced host), banded at 0.5
+    headline = min(v["step_ratio_vs_plain"] for v in legs.values())
+    return {
+        "metric": metric,
+        "value": round(headline, 3),
+        "unit": unit,
+        "vs_baseline": round(headline / 0.5, 4),
+        "platform": devices[0].platform,
+        "device_kind": devices[0].device_kind,
+        "n_devices": len(devices),
+        "degenerate": False,
+        "pipe_stages": n_stages,
+        "n_micro": n_micro,
+        "seq_len": seq,
+        "vocab": vocab,
+        "model_dims": {"num_heads": heads, "head_dim": head_dim,
+                       "mlp_dim": mlp},
+        "timed_steps": TIMED_STEPS,
+        "schedule": "1f1b",
+        "compose_legs": legs,
+        "tp_leg_skipped": not can_tp,
+        "wall_caveat": ("1-core host: 8 virtual devices time-slice, so "
+                        "the compose waves are serialised extra work and "
+                        "the ratio is a regression tripwire, not the "
+                        "lockstep win; legs_r22 measures real chips"),
+    }
+
+
 def run_quant() -> dict:
     """Low-precision compute proof (``--quant_compute {int8,fp8}``,
     ops/quant.py + the quantized ring kernels in
@@ -4362,6 +4568,8 @@ def main() -> None:
             _emit(run_mem())
         elif MODE == "pipe":
             _emit(run_pipe())
+        elif MODE == "pipe_compose":
+            _emit(run_pipe_compose())
         elif MODE == "quant":
             _emit(run_quant())
         elif MODE == "elastic":
@@ -4382,8 +4590,8 @@ def main() -> None:
             raise ValueError(
                 f"unknown BENCH_MODE {MODE!r}; expected "
                 "train|e2e|scaling|flash|compile|overlap|comms|tp|"
-                "overlap3d|obs|perf|fleet|mem|pipe|quant|elastic|serve|"
-                "spec|serve_tp"
+                "overlap3d|obs|perf|fleet|mem|pipe|pipe_compose|quant|"
+                "elastic|serve|spec|serve_tp"
             )
     except KeyboardInterrupt:  # operator abort is not a value-0 datum
         raise

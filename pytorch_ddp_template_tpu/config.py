@@ -90,7 +90,10 @@ class TrainingConfig:
     #                             under layer k's compute and drains layer
     #                             k's grad reduction under layer k-1's
     #                             backward. Implies --fsdp; needs
-    #                             --scan_layers; data-only meshes
+    #                             --scan_layers; data-only meshes. On the
+    #                             pipelined entries: slot-boundary
+    #                             gather/scatter waves instead (pipe×fsdp,
+    #                             r22, parallel/pipeline.py)
     xla_overlap_flags: bool = False  # set the XLA latency-hiding-scheduler
     #                                  flag pack (async collectives overlap
     #                                  with compute) before backend init;
@@ -102,7 +105,10 @@ class TrainingConfig:
     #                            reverse-scan iteration (the TPU-native
     #                            form of DDP bucketing). Needs
     #                            --scan_layers; replicated params on
-    #                            data-only meshes; FSDP/MoE/pipe refused
+    #                            data-only meshes; FSDP/MoE refused. On
+    #                            the pipelined entries: per-slot masked
+    #                            reduces at the slot boundary (pipe×ddp,
+    #                            r22, parallel/pipeline.py)
     grad_comm: str = "fp32"  # wire precision of the per-layer grad reduce
     #                          under --ddp_overlap: fp32 | bf16 | int8
     #                          (chunked symmetric quantization with
@@ -126,7 +132,10 @@ class TrainingConfig:
     #                           head rides the same ring (ops/lm_head.py).
     #                           Needs --scan_layers and a `model` mesh
     #                           axis; composes with --fsdp_overlap /
-    #                           --ddp_overlap (r11); MoE/pipe refused
+    #                           --ddp_overlap (r11); MoE refused. On the
+    #                           pipelined entries: psum-form Megatron TP
+    #                           inside each stage, collectives hoisted to
+    #                           the slot boundary (pipe×tp, r22)
     quant_compute: str = "off"  # low-precision compute path
     #                             (ops/quant.py): off | int8 | fp8. The
     #                             transformer block matmuls
@@ -551,13 +560,21 @@ class TrainingConfig:
             except ValueError:
                 return  # malformed spec: leave it to parse_mesh_spec
         live = {n: s for n, s in axes.items() if s == -1 or s > 1}
-        extra = {n: s for n, s in live.items()
-                 if n not in ("data", "model")}
+        # the pipelined entries compose pipe with one of tp/ddp/fsdp
+        # since r22 (parallel/pipeline.py boundary-hoisted waves), so a
+        # live pipe axis is admitted there; the per-run refusal matrix
+        # (parallel/schedule.py::validate_schedule_mesh) still applies
+        # at build time
+        allowed = {"data", "model"}
+        if self.model.startswith("gpt-pipe"):
+            allowed.add("pipe")
+        extra = {n: s for n, s in live.items() if n not in allowed}
         if extra:
             raise ValueError(
-                f"{flags} composes over data×model only, but --mesh "
-                f"{self.mesh!r} has live axes {extra} — drop those axes "
-                "or the overlap flags"
+                f"{flags} composes over data×model only (plus pipe on "
+                f"the pipelined entries), but --mesh {self.mesh!r} has "
+                f"live axes {extra} — drop those axes or the overlap "
+                "flags"
             )
         if self.tp_overlap and "model" not in live:
             raise ValueError(

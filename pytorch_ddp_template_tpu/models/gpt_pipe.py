@@ -42,9 +42,19 @@ composition —
   outputs, pinned by test — so the deferred dw products are pure
   einsums over the taps with no second recompute.
 
-Scope note: stages carry no intra-stage TP annotations (compose
-``pipe`` with ``data``; use the non-pipe entries for TP/CP
-composition — ``models/registry.py`` refuses the crosses with intent).
+Since round 22 the 1f1b schedule composes with ONE in-stage
+decomposition (``--tp_overlap`` / ``--ddp_overlap`` /
+``--fsdp_overlap``) through the boundary-hoisted collective waves in
+``parallel/pipeline.py``. The pipe×tp stage kernel here is the phased
+Megatron layout (column-parallel qkv/fc1, row-parallel out/fc2,
+replicated activations, two model all-reduces per layer) with every
+cross-model sum routed through the driver's injected ``psum`` so it
+issues at the slot body's top level, and every local vjp segment
+routed through the injected ``guard``. The blocks' init metadata
+carries the same ``_BLOCK_LOGICAL_AXES`` placement the non-pipe
+decomposed schedules use, so the stage weights genuinely shard over
+``model`` (and the names resolve to nothing on model-free meshes).
+What still refuses is named in ``models/registry.py``.
 """
 
 from __future__ import annotations
@@ -65,7 +75,7 @@ from ..parallel.pipeline import (
     pipelined_loss,
     schedule_bubble_fraction,
 )
-from ..runtime.context import DATA_AXIS, PIPE_AXIS
+from ..runtime.context import DATA_AXIS, MODEL_AXIS, PIPE_AXIS
 from ..utils import get_logger
 from .gpt import CausalLmTask
 from .transformer import EncoderBlock, _plain_dense, default_kernel_init
@@ -94,7 +104,9 @@ class PipelinedGptTask(CausalLmTask):
                  seq_len: int, num_layers: int, num_heads: int,
                  head_dim: int, mlp_dim: int,
                  dtype: jnp.dtype = jnp.float32, n_micro: int = 4,
-                 pipe_schedule: str = "1f1b", scan_layers: bool = False):
+                 pipe_schedule: str = "1f1b", scan_layers: bool = False,
+                 tp_overlap: bool = False, ddp_overlap: bool = False,
+                 fsdp_overlap: bool = False, grad_comm: str = "fp32"):
         # no monolithic flax module: registry knob guards (--remat /
         # --fused_head) see model=None and refuse with intent
         self.model = None
@@ -105,6 +117,20 @@ class PipelinedGptTask(CausalLmTask):
                 f"of {PIPE_SCHEDULES}")
         self.pipe_schedule = pipe_schedule
         self.scan_layers = scan_layers
+        on = [n for n, v in (("tp", tp_overlap), ("ddp", ddp_overlap),
+                             ("fsdp", fsdp_overlap)) if v]
+        if len(on) > 1:
+            raise ValueError(
+                "the pipelined entries compose pipe with exactly ONE of "
+                f"tp/ddp/fsdp per run, got {'+'.join(on)} — the slot "
+                "boundary carries one uniform collective wave")
+        self.compose = on[0] if on else "none"
+        self.grad_comm = grad_comm
+        if self.compose != "none" and pipe_schedule != "1f1b":
+            raise ValueError(
+                f"pipe×{self.compose} rides the 1f1b slot loop only "
+                f"(got --pipe_schedule {pipe_schedule!r}); see "
+                "parallel.pipeline.pipelined_loss")
         # Validation is DEFERRED to first use (init/forward): dataset-only
         # consumers of the registry (tools/make_file_dataset.py,
         # input_bench) build the entry under the default mesh and never
@@ -120,6 +146,16 @@ class PipelinedGptTask(CausalLmTask):
                     f"size {self.n_stages}"
                 )
             self.layers_per_stage = num_layers // self.n_stages
+            if self.compose != "none":
+                # the compose modes have a real mesh contract (model
+                # axis for tp, data axis for ddp/fsdp) — check it where
+                # the pipeline itself becomes live, same deferred spot
+                # as the stage-count check above
+                from ..parallel.schedule import validate_schedule_mesh
+
+                validate_schedule_mesh(
+                    mesh, pipe=True, tp=tp_overlap, ddp=ddp_overlap,
+                    fsdp=fsdp_overlap)
         self.vocab_size = vocab_size
         self.seq_len = seq_len
         self.num_layers = num_layers
@@ -164,6 +200,26 @@ class PipelinedGptTask(CausalLmTask):
         return schedule_bubble_fraction(
             self.pipe_schedule, self.effective_microbatches(batch_size),
             self.n_stages)
+
+    def model_wire_bytes_per_step(self, batch_size: int) -> int:
+        """Static model-axis wire figure for the r22 pipe×tp compose
+        wave (zero for every other compose mode): the attribution
+        engine uses it to split the all-reduce census between the data
+        grad reduce and the TP psums on pipe×tp meshes
+        (obs/attribution.py::static_cost_model)."""
+        if self.compose != "tp" or self.n_stages is None:
+            return 0
+        from ..parallel.schedule import PipelineSchedule
+
+        model = self.mesh.shape.get(MODEL_AXIS, 1)
+        data = self.mesh.shape.get(DATA_AXIS, 1)
+        m = self.effective_microbatches(batch_size)
+        mb = max((batch_size // max(data, 1)) // max(m, 1), 1)
+        sched = PipelineSchedule(self.mesh, self.pipe_schedule, m,
+                                 tp=True)
+        return sched.tp_wave_bytes_per_step(
+            mb, self.seq_len, self.embed_dim, self.layers_per_stage,
+            model, itemsize=jnp.dtype(self.dtype).itemsize)
 
     def _microbatch_count(self, b: int) -> int:
         """Effective count for a concrete batch, with the clamp policy:
@@ -217,13 +273,29 @@ class PipelinedGptTask(CausalLmTask):
             for i in range(self.num_layers)
         ]
         stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
-        staged = jax.tree.map(
-            lambda a: nn.Partitioned(
-                a.reshape(self.n_stages, self.layers_per_stage, *a.shape[1:]),
-                names=(PIPE_STAGE_AXIS,) + (None,) * a.ndim,
-            ),
-            stacked,
-        )
+        from ..parallel.schedule import _BLOCK_LOGICAL_AXES, _path_keys
+
+        def _stage_leaf(path, a):
+            r = a.reshape(
+                self.n_stages, self.layers_per_stage, *a.shape[1:])
+            keys = _path_keys(path)
+            axes = (_BLOCK_LOGICAL_AXES.get(keys[-2:])
+                    if len(keys) >= 2 else None)
+            if axes is None or len(axes) != r.ndim - 2:
+                raise ValueError(
+                    f"pipelined init: unknown block param at path "
+                    f"{'/'.join(keys)} — extend _BLOCK_LOGICAL_AXES "
+                    "(parallel/schedule.py) so its (pipe, model) "
+                    "placement is known")
+            # (stage, layer, *param) with the stage dim on 'pipe' and
+            # the trailing dims on the SAME logical placement the
+            # non-pipe decomposed schedules use — under a model-free
+            # mesh the trailing names resolve to nothing (replicated),
+            # so this is the old layout there
+            return nn.Partitioned(
+                r, names=(PIPE_STAGE_AXIS, None) + tuple(axes))
+
+        staged = jax.tree_util.tree_map_with_path(_stage_leaf, stacked)
         params = {
             "wte": default_kernel_init(
                 k_wte, (self.vocab_size, self.embed_dim), jnp.float32),
@@ -380,6 +452,141 @@ class PipelinedGptTask(CausalLmTask):
                                g["ln_mlp"]),
         }
 
+    # -- tensor-parallel stage kernel (pipe×tp, r22) -----------------------
+    #
+    # Megatron phased layout over model-sharded stage weights with
+    # replicated activations: qkv/fc1 column-parallel (no forward
+    # collective — outputs local over heads/mlp), out/fc2 row-parallel
+    # (forward psums the partial products; their biases are replicated
+    # and added ONCE, after the psum). The backward never differentiates
+    # through a collective: ``jax.vjp`` is applied to the purely-local
+    # segments below, the cross-model sums of the activation cotangents
+    # and the (partial) LN param grads are issued manually — one joint
+    # psum per segment, between the guards, uniform across stages.
+
+    def _tp_attn_seg_params(self, lp):
+        at = lp["attention"]
+        return {"ln_attn": lp["ln_attn"], "query": at["query"],
+                "key": at["key"], "value": at["value"],
+                "out_kernel": at["out"]["kernel"]}
+
+    def _tp_mlp_seg_params(self, lp):
+        return {"ln_mlp": lp["ln_mlp"], "fc1": lp["mlp"]["fc1"],
+                "fc2_kernel": lp["mlp"]["fc2"]["kernel"]}
+
+    def _tp_seg_attn(self, seg_p, x):
+        """LN → column-parallel qkv → attention over local heads →
+        row-parallel out contraction. Returns the model-PARTIAL out
+        product (the caller psums it); purely local — safe to vjp."""
+        dt = self.dtype
+        h1 = self._ln.apply({"params": seg_p["ln_attn"]}, x).astype(dt)
+        q = _plain_dense(h1, seg_p["query"]["kernel"],
+                         seg_p["query"]["bias"], 1, dt)
+        k = _plain_dense(h1, seg_p["key"]["kernel"],
+                         seg_p["key"]["bias"], 1, dt)
+        v = _plain_dense(h1, seg_p["value"]["kernel"],
+                         seg_p["value"]["bias"], 1, dt)
+        ctx = attention(q, k, v, mask=None, causal=True,
+                        impl=self._block.attn_impl)
+        axes = (ctx.ndim - 2, ctx.ndim - 1)
+        return lax.dot_general(
+            ctx.astype(dt), seg_p["out_kernel"].astype(dt),
+            ((axes, (0, 1)), ((), ())))
+
+    def _tp_seg_mlp(self, seg_p, x1):
+        """LN → column-parallel fc1 → gelu → row-parallel fc2
+        contraction; returns the model-PARTIAL fc2 product."""
+        dt = self.dtype
+        h2 = self._ln.apply({"params": seg_p["ln_mlp"]}, x1).astype(dt)
+        f1 = _plain_dense(h2, seg_p["fc1"]["kernel"],
+                          seg_p["fc1"]["bias"], 1, dt)
+        a1 = nn.gelu(f1)
+        return lax.dot_general(
+            a1, seg_p["fc2_kernel"].astype(dt),
+            (((a1.ndim - 1,), (0,)), ((), ())))
+
+    def _tp_stage_fwd(self, stage_w, x, psum):
+        """Phased stage forward: two ``psum`` calls per layer (out and
+        fc2 partials), issued by the driver at the slot body's top
+        level. Taps are the per-layer ``(x, x1)`` residual-stream
+        points the backward sweep's segment vjps restart from."""
+        dt = self.dtype
+        h = x
+        taps = []
+        for li in range(self.layers_per_stage):
+            lp = jax.tree.map(lambda a, li=li: a[li], stage_w)
+            o = (psum(self._tp_seg_attn(self._tp_attn_seg_params(lp), h))
+                 + lp["attention"]["out"]["bias"].astype(dt))
+            x1 = h + o
+            f2 = (psum(self._tp_seg_mlp(self._tp_mlp_seg_params(lp), x1))
+                  + lp["mlp"]["fc2"]["bias"].astype(dt))
+            taps.append((h, x1))
+            h = x1 + f2
+        return h, tuple(taps)
+
+    @staticmethod
+    def _tp_seg_vjp(seg, seg_p, x, g):
+        """vjp of one purely-local segment: (param grads, input
+        cotangent). The param grads of the column/row kernels and the
+        qkv/fc1 biases are local-COMPLETE (replicated activations ×
+        local cotangents); the LN grads inside ``seg_p`` come out
+        model-PARTIAL (their cotangent flows through the local-heads
+        sum) — the caller psums them jointly with ``dx``."""
+        _, pull = jax.vjp(seg, seg_p, x)
+        dp, dx = pull(g)
+        return dp, dx
+
+    def _tp_stage_bwd(self, stage_w, taps, gy, psum, guard):
+        """Phased stage backward, layers reversed. Per layer: the mlp
+        and attn segments' local vjps run under ``guard`` (collective-
+        free), and ONE joint psum per segment — (activation cotangent,
+        LN param grads) — issues between them, uniform across stages
+        (idle stages feed zeros). The replicated out/fc2 biases are
+        excluded from the segments: their grads are plain sums of the
+        (replicated, zero-when-idle) cotangents, no collective at all."""
+        f32 = jnp.float32
+        g = gy
+        gw_layers = []
+        for li in reversed(range(self.layers_per_stage)):
+            lp = jax.tree.map(lambda a, li=li: a[li], stage_w)
+            # the forward sweep of the SAME slot produced these for the
+            # microbatch being backpropped (on B slots it is the
+            # recompute-from-boundary) — no second recompute here
+            x, x1 = taps[li]
+            attn_p = self._tp_attn_seg_params(lp)
+            mlp_p = self._tp_mlp_seg_params(lp)
+            db_fc2 = jnp.sum(g.astype(f32), axis=(0, 1)).astype(
+                lp["mlp"]["fc2"]["bias"].dtype)
+            d_mlp, d_x1_part = guard(
+                lambda: self._tp_seg_vjp(self._tp_seg_mlp, mlp_p, x1, g))
+            d_x1_seg, d_ln_mlp = psum((d_x1_part, d_mlp["ln_mlp"]))
+            d_x1 = g + d_x1_seg
+            db_out = jnp.sum(d_x1.astype(f32), axis=(0, 1)).astype(
+                lp["attention"]["out"]["bias"].dtype)
+            d_attn, d_x_part = guard(
+                lambda: self._tp_seg_vjp(
+                    self._tp_seg_attn, attn_p, x, d_x1))
+            d_x_seg, d_ln_attn = psum((d_x_part, d_attn["ln_attn"]))
+            g = d_x1 + d_x_seg
+            gw_layers.append({
+                "attention": {
+                    "query": d_attn["query"], "key": d_attn["key"],
+                    "value": d_attn["value"],
+                    "out": {"kernel": d_attn["out_kernel"],
+                            "bias": db_out},
+                },
+                "mlp": {
+                    "fc1": d_mlp["fc1"],
+                    "fc2": {"kernel": d_mlp["fc2_kernel"],
+                            "bias": db_fc2},
+                },
+                "ln_attn": d_ln_attn,
+                "ln_mlp": d_ln_mlp,
+            })
+        gw_layers.reverse()
+        gw = jax.tree.map(lambda *xs: jnp.stack(xs), *gw_layers)
+        return g, gw
+
     # -- tail (last stage, per microbatch) ---------------------------------
     def _tail_terms(self, tail_p, y, ids_mb, wt_mb):
         """Per-microbatch final-LN + tied head + next-token loss sums —
@@ -418,6 +625,8 @@ class PipelinedGptTask(CausalLmTask):
             fwd_tapped=self._stage_fwd_tapped,
             make_probes=self._make_probes,
             dw_from_taps=self._dw_from_taps,
+            tp_fwd=self._tp_stage_fwd,
+            tp_bwd=self._tp_stage_bwd,
         )
 
     # -- forward (gpipe / eval) -------------------------------------------
@@ -466,9 +675,26 @@ class PipelinedGptTask(CausalLmTask):
             "final_ln": nn.meta.unbox(params["final_ln"]),
             "wte": nn.meta.unbox(params["wte"]),
         }
+        blocks = nn.meta.unbox(params["blocks"])
+        extra = {}
+        if self.compose == "tp":
+            from ..parallel.schedule import staged_tp_specs
+
+            extra = dict(compose="tp",
+                         stage_specs=staged_tp_specs(blocks, self.mesh))
+        elif self.compose == "ddp":
+            extra = dict(compose="ddp", grad_comm=self.grad_comm)
+            if self.grad_comm != "fp32":
+                if rng is None:
+                    raise ValueError(
+                        "lossy --grad_comm under pipe×ddp needs the "
+                        "training rng (per-slot stochastic rounding)")
+                extra["comm_rng"] = jax.random.fold_in(rng, 0x9e22)
+        elif self.compose == "fsdp":
+            extra = dict(compose="fsdp")
         loss_sum, hits_sum = pipelined_loss(
-            table, self._kernel(), nn.meta.unbox(params["blocks"]),
-            tail_p, xm, ids_m, wt_m, self.mesh)
+            table, self._kernel(), blocks,
+            tail_p, xm, ids_m, wt_m, self.mesh, **extra)
         metrics = self.weighted_metrics(
             w.sum() * (t - 1), train,
             loss=loss_sum, next_token_accuracy=hits_sum)

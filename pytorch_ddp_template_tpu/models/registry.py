@@ -84,27 +84,58 @@ def build(name: str, config: TrainingConfig, mesh=None) -> tuple[Task, Dataset]:
     if name.startswith("gpt-pipe"):
         # the pipelined entries run OUTSIDE the flax-module knob surface
         # (task.model is None): their schedule composition is validated
-        # here, with pipe-specific reasons, before any tracing
-        for flag, why in (
-            ("tp_overlap", "the ring kernels would have to be traced "
-                           "inside the slot loop's switch branches — a "
-                           "collective inside a divergent-predicate "
-                           "conditional deadlocks on real hardware"),
-            ("fsdp_overlap", "the per-layer weight gathers would have to "
-                             "thread through the slot loop's carry"),
-            ("fsdp", "stage weights already shard over the pipe axis; "
-                     "an additional data split of the stage stack needs "
-                     "gathers the slot schedule does not issue"),
-            ("ddp_overlap", "the per-layer grad reduce would have to "
-                            "drain from inside the slot loop"),
-        ):
-            if getattr(config, flag, False):
-                raise ValueError(
-                    f"--{flag} does not compose with the pipelined "
-                    f"entries ({name!r}) yet: {why}; the pipeline "
-                    "composes with plain data parallelism (pipe×data) "
-                    "only — drop the flag or use a non-pipe entry"
-                )
+        # here, with pipe-specific reasons, before any tracing. Since
+        # r22 the 1f1b slot loop composes with ONE of tp/ddp/fsdp
+        # (boundary-hoisted collective waves — parallel/pipeline.py);
+        # what remains refused is genuinely impossible, reason named.
+        compose_on = [f for f in ("tp_overlap", "ddp_overlap",
+                                  "fsdp_overlap")
+                      if getattr(config, f, False)]
+        if config.fsdp and not config.fsdp_overlap:
+            raise ValueError(
+                f"--fsdp does not compose with the pipelined entries "
+                f"({name!r}): GSPMD-managed data splits of the stage "
+                "stack would be silently re-gathered by the slot "
+                "region's specs every step; use --fsdp_overlap — the "
+                "slot-boundary gather/scatter wave — instead"
+            )
+        if len(compose_on) > 1:
+            raise ValueError(
+                f"--{' --'.join(compose_on)}: the pipelined entries "
+                f"({name!r}) compose pipe with exactly ONE of "
+                "tp/ddp/fsdp per run (the slot boundary carries one "
+                "uniform collective wave); drop all but one flag"
+            )
+        if compose_on and config.pipe_schedule != "1f1b":
+            raise ValueError(
+                f"--{compose_on[0]} rides the 1f1b slot loop only: "
+                "gpipe differentiates through the masked fill/drain "
+                "loop (no slot boundary to hoist collectives to) and "
+                "zb's bit-exact tapped backward has no decomposed twin "
+                "yet; pass --pipe_schedule 1f1b"
+            )
+        if config.ddp_overlap and config.grad_error_feedback:
+            raise ValueError(
+                "--grad_error_feedback does not compose with the "
+                f"pipelined entries ({name!r}): the residual would have "
+                "to telescope across the slot loop's per-microbatch "
+                "partial reduces instead of whole-step gradients; drop "
+                "the flag or use a non-pipe entry"
+            )
+        if compose_on:
+            from ..parallel.schedule import validate_schedule_mesh
+            from ..runtime import make_mesh
+
+            import jax
+
+            if mesh is None:
+                mesh = make_mesh(config.mesh, jax.devices())
+            # fail fast, before any tracing, with the pipe-aware
+            # refusal matrix (pipe×data×model for tp, pipe×data for
+            # ddp/fsdp)
+            validate_schedule_mesh(
+                mesh, pipe=True, tp=config.tp_overlap,
+                ddp=config.ddp_overlap, fsdp=config.fsdp_overlap)
         if getattr(config, "quant_compute", "off") != "off":
             raise ValueError(
                 f"--quant_compute does not compose with the pipelined "
@@ -130,7 +161,7 @@ def build(name: str, config: TrainingConfig, mesh=None) -> tuple[Task, Dataset]:
                     "layer stack to scan (transformer families only)"
                 )
             task.model = task.model.clone(scan_layers=True)
-    if config.fsdp_overlap:
+    if config.fsdp_overlap and not name.startswith("gpt-pipe"):
         if not config.scan_layers:
             raise ValueError(
                 "--fsdp_overlap needs --scan_layers: the stacked "
@@ -161,7 +192,7 @@ def build(name: str, config: TrainingConfig, mesh=None) -> tuple[Task, Dataset]:
         # admits the model axis the gather specs will carry
         validate_overlap_mesh(mesh, tp=config.tp_overlap)
         task.model = task.model.clone(fsdp_overlap=True, mesh=mesh)
-    if config.ddp_overlap:
+    if config.ddp_overlap and not name.startswith("gpt-pipe"):
         if not config.scan_layers:
             raise ValueError(
                 "--ddp_overlap needs --scan_layers: the stacked "
@@ -194,7 +225,7 @@ def build(name: str, config: TrainingConfig, mesh=None) -> tuple[Task, Dataset]:
         task.model = task.model.clone(
             ddp_overlap=True, mesh=mesh, grad_comm=config.grad_comm,
             grad_error_feedback=config.grad_error_feedback)
-    if config.tp_overlap:
+    if config.tp_overlap and not name.startswith("gpt-pipe"):
         # --scan_layers is co-required by config.__post_init__; this path
         # also covers direct TrainingConfig construction with both set
         if not hasattr(task.model, "tp_overlap"):
@@ -546,7 +577,11 @@ def _gpt_pipe_tiny(config: TrainingConfig, mesh=None):
                             num_layers=4, num_heads=4, head_dim=16,
                             mlp_dim=128, dtype=_dtype(config),
                             n_micro=config.pipe_microbatches,
-                            pipe_schedule=config.pipe_schedule)
+                            pipe_schedule=config.pipe_schedule,
+                            tp_overlap=config.tp_overlap,
+                            ddp_overlap=config.ddp_overlap,
+                            fsdp_overlap=config.fsdp_overlap,
+                            grad_comm=config.grad_comm)
     return _token_entry(config, task, seq_len, vocab)
 
 

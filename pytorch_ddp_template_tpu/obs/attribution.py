@@ -163,7 +163,9 @@ def cost_of(compiled) -> dict:
 
 def static_cost_model(compiled, axis_sizes: dict[str, int] | None = None,
                       hlo_text: str | None = None,
-                      pipe_bubble_frac: float = 0.0) -> dict[str, Any]:
+                      pipe_bubble_frac: float = 0.0,
+                      model_wire_bytes_per_step: float = 0.0
+                      ) -> dict[str, Any]:
     """The a-priori per-step budget of one compiled train step.
 
     ``compiled`` is the AOT executable (``jit(...).lower(...).compile()``)
@@ -188,6 +190,19 @@ def static_cost_model(compiled, axis_sizes: dict[str, int] | None = None,
       run's (schedule, M, P); the engine passes it for the pipelined
       entries). Zeroed when the mesh has no live ``pipe`` axis — the
       r16 convention mirroring the wire-byte axis gating.
+
+    r22 pipe-mesh attribution: on a live ``pipe`` axis the
+    collective-permutes ARE the stage-boundary hops, so their bytes go
+    to a ``wire_bytes_pipe`` bucket instead of ``model``. With a model
+    axis ALSO live (pipe×tp), the model-axis psums share the
+    all-reduce spelling with the data-axis grad reduce, and the census
+    alone cannot split the opcode between axes — the caller passes the
+    STATIC model ring-wire figure (``model_wire_bytes_per_step``, e.g.
+    ``PipelineSchedule``'s per-step TP wave estimate) and that many
+    gather-family bytes are re-attributed from ``data`` to ``model``
+    (clamped to what the census actually carries — the figure is an
+    estimate, never invented traffic). Off pipe meshes the parameter
+    is ignored and the r11 family convention stands unchanged.
     """
     axis_sizes = dict(axis_sizes or {})
     c = cost_of(compiled)
@@ -199,17 +214,29 @@ def static_cost_model(compiled, axis_sizes: dict[str, int] | None = None,
     census = op_census(hlo_text)
     data_live = axis_sizes.get("data", 1) > 1
     model_live = axis_sizes.get("model", 1) > 1
-    wire_data = sum(v["wire_bytes"] for k, v in census.items()
-                    if k in GATHER_FAMILY) if data_live else 0
-    wire_model = sum(v["wire_bytes"] for k, v in census.items()
-                     if k in RING_FAMILY) if model_live else 0
     pipe_live = axis_sizes.get("pipe", 1) > 1
+    gather_bytes = sum(v["wire_bytes"] for k, v in census.items()
+                       if k in GATHER_FAMILY)
+    ring_bytes = sum(v["wire_bytes"] for k, v in census.items()
+                     if k in RING_FAMILY)
+    wire_pipe = 0
+    if pipe_live:
+        wire_pipe = ring_bytes
+        wire_model = 0
+        if model_live:
+            wire_model = min(int(model_wire_bytes_per_step),
+                             gather_bytes)
+        wire_data = (gather_bytes - wire_model) if data_live else 0
+    else:
+        wire_data = gather_bytes if data_live else 0
+        wire_model = ring_bytes if model_live else 0
     return {
         "flops_per_step": c["flops"],
         "hbm_bytes_per_step": c["bytes"],
         "wire_bytes_data": int(wire_data),
         "wire_bytes_model": int(wire_model),
-        "wire_bytes_total": int(wire_data + wire_model),
+        "wire_bytes_pipe": int(wire_pipe),
+        "wire_bytes_total": int(wire_data + wire_model + wire_pipe),
         "collective_ops": census,
         "pipe_bubble_frac": (float(pipe_bubble_frac) if pipe_live
                              else 0.0),
@@ -265,6 +292,9 @@ class PerfAttribution:
             "wire_mb_per_step_model": round(
                 cm.get("wire_bytes_model", 0) / 1e6, 3),
         }
+        if cm.get("wire_bytes_pipe"):
+            out["wire_mb_per_step_pipe"] = round(
+                cm["wire_bytes_pipe"] / 1e6, 3)
         if self.peak_flops:
             out["peak_tflops"] = round(self.peak_flops / 1e12, 2)
         if self.compute_dtype not in ("bf16", "off"):

@@ -302,6 +302,18 @@ def pipe_evidence(hlo_text: str) -> dict[str, Any]:
     scopes the driver stamps; scope metadata survives into the compiled
     dump on this toolchain — absent metadata degrades this to False,
     never a crash).
+
+    r22 (the compose invariant): ``branch_collectives`` counts
+    collective ops (all-reduce / all-gather / reduce-scatter /
+    all-to-all / collective-permute, plus their async ``-start``
+    twins) reachable from any ``conditional``'s branch computations —
+    transitively, through nested calls/fusions/whiles. The
+    boundary-hoisting contract says every compose-wave collective
+    sits at the slot-body top level, uniform across stages; a
+    collective inside a branch executes under a divergent stage
+    predicate and deadlocks on real hardware, so
+    ``branch_collectives_free`` (== 0) is the tripwire the pipe×
+    {tp,ddp,fsdp} tests pin.
     """
     # dots per computation (direct) + the nested-reachability map
     refs = _computation_refs(hlo_text)
@@ -376,6 +388,44 @@ def pipe_evidence(hlo_text: str) -> dict[str, Any]:
     conditional_count = sum(
         1 for _, instrs in comps
         for s in instrs if " conditional(" in s)
+
+    # r22 compose invariant: no collective may execute under a branch
+    # predicate. Collect every computation named by a conditional's
+    # branch list, close over nested references, and count collective
+    # ops inside the closure.
+    _COLL = ("all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+             "collective-permute")
+
+    def _is_collective(instr: str) -> bool:
+        return any(f" {op}(" in instr or f" {op}-start(" in instr
+                   for op in _COLL)
+
+    branch_roots: set[str] = set()
+    for _, instrs in comps:
+        for s in instrs:
+            if " conditional(" not in s:
+                continue
+            m = re.search(r"branch_computations=\{([^}]*)\}", s)
+            if m:
+                for tok in m.group(1).split(","):
+                    branch_roots.add(tok.strip().lstrip("%"))
+            for m in re.finditer(r"(?:true|false)_computation="
+                                 r"(%?[\w.\-]+)", s):
+                branch_roots.add(m.group(1).lstrip("%"))
+    reach = set(branch_roots)
+    frontier = list(branch_roots)
+    while frontier:
+        nxt = frontier.pop()
+        for r in refs.get(nxt, ()):
+            if r not in reach:
+                reach.add(r)
+                frontier.append(r)
+    instrs_by_name = {name.lstrip("%"): instrs for name, instrs in comps}
+    branch_collectives = sum(
+        1 for cname in reach
+        for s in instrs_by_name.get(cname, ())
+        if _is_collective(s))
+
     return {
         "bodies": rows,
         "slot_bodies": len(rows),
@@ -383,6 +433,9 @@ def pipe_evidence(hlo_text: str) -> dict[str, Any]:
         "pipe_sends_independent": bool(rows) and (
             len(independent_bodies) == len(rows)),
         "conditional_count": conditional_count,
+        "branch_computation_count": len(branch_roots),
+        "branch_collectives": branch_collectives,
+        "branch_collectives_free": branch_collectives == 0,
         "dw_ops_present": ("pipe_stage_dw" in hlo_text
                            or "pipe_dw_wave" in hlo_text),
     }
@@ -653,7 +706,14 @@ def check_overlap_expectations(report: dict[str, Any], config: Any,
     model = axis_sizes.get("model", 1)
     gather = report["gather"]
     ring = report["ring"]
-    if getattr(config, "fsdp_overlap", False) and data > 1:
+    # on the pipelined entries the overlap flags select the slot-boundary
+    # compose waves (parallel/pipeline.py), not the scanned-stack
+    # machinery these witnesses describe — their evidence is the r22
+    # branch-collective invariant below, so the scan-shaped checks are
+    # skipped rather than allowed to fire vacuous warnings
+    pipe_model = str(getattr(config, "model", "")).startswith("gpt-pipe")
+    if (getattr(config, "fsdp_overlap", False) and data > 1
+            and not pipe_model):
         if gather["independent_bodies"] < 1:
             warns.append(
                 "--fsdp_overlap is on but NO dot-carrying loop body has a "
@@ -663,7 +723,8 @@ def check_overlap_expectations(report: dict[str, Any], config: Any,
                 f"(bodies={gather['dot_carrying_bodies']}, "
                 f"dependent={gather['dependent_collectives']})"
             )
-    if getattr(config, "ddp_overlap", False) and data > 1:
+    if (getattr(config, "ddp_overlap", False) and data > 1
+            and not pipe_model):
         per_layer = sum(r["collectives"] for r in gather["bodies"])
         if per_layer < 1:
             warns.append(
@@ -672,7 +733,8 @@ def check_overlap_expectations(report: dict[str, Any], config: Any,
                 "reduce has left the backward scan — gradients are "
                 "draining as one post-backward wall again"
             )
-    if getattr(config, "tp_overlap", False) and model > 1:
+    if (getattr(config, "tp_overlap", False) and model > 1
+            and not pipe_model):
         if ring["independent_ring_bodies"] < 1:
             warns.append(
                 "--tp_overlap is on but no dot-carrying loop body carries "
@@ -698,7 +760,6 @@ def check_overlap_expectations(report: dict[str, Any], config: Any,
     # be in the program (their absence means the split backward has
     # silently degraded to the fused one)
     pipe_axis = axis_sizes.get("pipe", 1)
-    pipe_model = str(getattr(config, "model", "")).startswith("gpt-pipe")
     if pipe_model and pipe_axis > 1:
         pe = report.get("pipe", {})
         sched = getattr(config, "pipe_schedule", "gpipe")
@@ -720,6 +781,22 @@ def check_overlap_expectations(report: dict[str, Any], config: Any,
                 "appears in the compiled program: the dx/dw split has "
                 "not survived compilation — the deferred dw wave that "
                 "fills the drain region is missing"
+            )
+        # r22 compose invariant: the boundary-hoisting contract admits
+        # NO collective under a branch predicate — one there executes
+        # only on the stages whose switch arm selects it, and a
+        # divergent collective deadlocks on real hardware. Checked
+        # whenever the slot loop compiles conditionals (compose flag or
+        # not: plain pipe must hold the invariant too).
+        if not pe.get("branch_collectives_free", True):
+            warns.append(
+                f"pipe schedule {sched!r}: "
+                f"{pe.get('branch_collectives', '?')} collective op(s) "
+                "are reachable from a conditional's branch_computations "
+                "— a collective under a divergent stage predicate is a "
+                "deadlock on real hardware; every compose-wave "
+                "collective must sit at the slot-body top level "
+                "(parallel/pipeline.py boundary-hoisting contract)"
             )
     # r17 quant tripwire: a --quant_compute run must actually carry
     # narrow-dtype dots (compute quantized), and composed with the TP

@@ -103,7 +103,10 @@ def validate_tp_mesh(mesh: Mesh | None) -> Mesh:
     if extra:
         raise ValueError(
             f"--tp_overlap supports data+model meshes only; mesh also has "
-            f"{extra} — drop the extra axes or drop --tp_overlap"
+            f"{extra} — drop the extra axes or drop --tp_overlap (a live "
+            "pipe axis composes with TP through the pipelined entries "
+            "only: --model gpt-pipe-* routes pipe×tp via "
+            "parallel/pipeline.py, not these ring regions)"
         )
     return mesh
 

@@ -2,6 +2,12 @@
 (``--ddp_overlap`` + ``--grad_comm {fp32,bf16,int8}`` +
 ``--grad_error_feedback``).
 
+Since r22 the pipelined entries reuse :func:`_reduce_tree` (and
+:data:`CHUNK`) for pipe×ddp: one masked per-slot reduce at the slot
+boundary of the 1f1b loop (``parallel/pipeline.py``), keyed per
+``(slot, leaf)`` for unbiased lossy wires; this module's own reverse
+scan stays data-mesh-only.
+
 Under plain replicated-param DDP the cross-replica gradient mean is left
 entirely to GSPMD: the batch is sharded over ``data``, params are
 replicated, and XLA inserts one fp32 all-reduce per gradient leaf after

@@ -1,6 +1,12 @@
 """Decomposed FSDP: explicit per-layer weight gathers, pipelined one layer
 ahead of compute (``--fsdp_overlap``).
 
+Since r22 the pipelined entries reuse the primitives here — the
+``fsdp_split_dim`` chooser (via ``parallel/sharding.py``), the
+``UNSPLIT`` sentinel and ``_zero_cotangent`` — to run pipe×fsdp as
+slot-boundary gather/scatter waves (``parallel/pipeline.py``); this
+module's own prefetch scan stays data-mesh-only.
+
 Under plain ``--fsdp`` the gather/scatter protocol is left entirely to
 GSPMD, whose default dataflow is "all-gather layer k → compute layer k":
 the ICI sits idle during every layer's matmuls and the matmuls wait on

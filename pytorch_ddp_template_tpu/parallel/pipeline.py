@@ -55,6 +55,38 @@ GPipe loop instead.
 ``pipe`` — each rank holds only its own stage — and when the mesh also
 has a live ``data`` axis the microbatch dim shards over it (pipe×data
 composition with real DP speedup).
+
+Round 22 removes the last structural refusal: the 1F1B slot loop now
+composes with ONE of tp / ddp / fsdp inside a stage
+(``pipelined_loss(compose=...)``). The rule that makes it safe on real
+hardware is *boundary hoisting*: every cross-replica collective issues
+at the slot boundary, uniformly across stages, never inside a
+divergent-predicate branch — idle stages contribute zeros (a psum of
+zeros is correct and uniform, where a skipped psum is a deadlock) and
+gather full-but-unused operands (a gather of valid shards is likewise
+uniform). Concretely:
+
+- ``compose="tp"`` drops the ``lax.switch`` entirely: the stage
+  forward sweep (``PipeStageKernel.tp_fwd`` — Megatron column/row
+  partition with replicated activations, two model all-reduces per
+  layer) runs UNGUARDED every slot — on F slots it is the forward, on
+  B slots it is the recompute-from-boundary, on idle slots it is
+  lockstep waste the bubble already pays for. The backward's purely
+  local vjp segments are guarded per-slot (``lax.cond`` on the traced
+  work id — divergent but collective-free), and its per-layer
+  activation + LN-grad all-reduces sit BETWEEN the guards at the slot
+  body's top level. ``jax.vjp`` is only ever applied to local segment
+  functions, never across a psum.
+- ``compose="ddp"`` keeps the switch (its branches were always
+  collective-free) and moves the gradient reduction from the post-loop
+  psum into a per-slot ``compress._reduce_tree`` wave at the slot
+  bottom — fp32 is exact by linearity of the sum; bf16/int8 fold the
+  (slot, stage) indices into the rounding key.
+- ``compose="fsdp"`` stores each stage's weights data-sharded along
+  the same free-dim placement the trainer uses, all-gathers them at
+  the slot top and psum-scatters the per-slot gradient back to shards
+  at the slot bottom — the pipelined twin of the decomposed-scan
+  layer-ahead gather.
 """
 
 from __future__ import annotations
@@ -70,7 +102,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..runtime.context import DATA_AXIS, PIPE_AXIS
+from ..runtime.context import DATA_AXIS, MODEL_AXIS, PIPE_AXIS
 from .stacking import check_leading_axis, stack_params
 
 #: the user-facing schedule names (--pipe_schedule)
@@ -478,6 +510,17 @@ class PipeStageKernel:
       carry an extra LEADING axis which the implementation contracts:
       the post-loop wave feeds it the whole per-microbatch tap store
       (one entry per microbatch) in one batched product.
+    - ``tp_fwd(stage_w, x, psum) -> (y, taps)`` (pipe×tp) — the phased
+      stage forward over model-sharded weights: all cross-model sums
+      go through the injected ``psum`` so the driver controls where
+      they issue; ``taps`` are the per-layer boundary activations the
+      backward sweep recomputes from.
+    - ``tp_bwd(stage_w, taps, gy, psum, guard) -> (gx, gw)`` (pipe×tp)
+      — the phased stage backward: every *local* vjp segment must be
+      wrapped in the injected ``guard`` (the driver gates it on the
+      slot's work id) and every cross-model sum must go through
+      ``psum`` OUTSIDE any guard, so idle stages feed zeros into a
+      uniform collective wave.
     """
 
     fwd: Callable
@@ -486,6 +529,8 @@ class PipeStageKernel:
     fwd_tapped: Callable | None = None
     make_probes: Callable | None = None
     dw_from_taps: Callable | None = None
+    tp_fwd: Callable | None = None
+    tp_bwd: Callable | None = None
 
 
 def _dyn(row, p):
@@ -508,7 +553,11 @@ def _store_write(store, slot, value, pred):
 def pipelined_loss(table: PipeTable, kernel: PipeStageKernel,
                    stage_params: Any, tail_params: Any,
                    x_feed: jax.Array, tgt: jax.Array, wt: jax.Array,
-                   mesh: Mesh) -> tuple[jax.Array, jax.Array]:
+                   mesh: Mesh, *, compose: str = "none",
+                   stage_specs: Any | None = None,
+                   grad_comm: str = "fp32",
+                   comm_rng: jax.Array | None = None
+                   ) -> tuple[jax.Array, jax.Array]:
     """Pipelined per-microbatch loss under ``table``'s fused schedule.
 
     Returns ``(loss_sum, hits_sum)`` — the per-microbatch tail sums
@@ -524,6 +573,19 @@ def pipelined_loss(table: PipeTable, kernel: PipeStageKernel,
     Without differentiation (eval) the cheap F-only fill/drain loop
     runs instead (:func:`pipeline_apply` + the per-microbatch tail),
     summing in the same per-microbatch order — the two paths agree.
+
+    ``compose`` picks the in-stage parallelism riding the slot loop
+    (1f1b only — see the module docstring for the boundary-hoisting
+    invariant each mode obeys):
+
+    - ``"none"``: pipe×data as before.
+    - ``"tp"``: model-sharded stage weights via the kernel's phased
+      ``tp_fwd``/``tp_bwd``; needs ``stage_specs`` (the per-leaf
+      PartitionSpecs placing each stacked leaf over (pipe, model)).
+    - ``"ddp"``: per-slot compressed gradient reduce over ``data``
+      (``grad_comm`` in fp32/bf16/int8; lossy modes need ``comm_rng``).
+    - ``"fsdp"``: data-sharded stage weights, slot-top all-gather +
+      slot-bottom psum-scatter.
     """
     M, Pn = table.n_micro, table.n_stages
     kind = table.kind
@@ -544,18 +606,77 @@ def pipelined_loss(table: PipeTable, kernel: PipeStageKernel,
                          or kernel.make_probes is None):
         raise ValueError("pipe_schedule=zb needs the tapped stage kernel "
                          "(fwd_tapped / make_probes / dw_from_taps)")
+    if compose not in ("none", "tp", "ddp", "fsdp"):
+        raise ValueError(
+            f"pipelined_loss: unknown compose mode {compose!r}; expected "
+            "'none', 'tp', 'ddp' or 'fsdp'")
+    if compose != "none" and kind != "1f1b":
+        raise ValueError(
+            f"pipe×{compose} rides the 1f1b slot loop only: gpipe "
+            "differentiates through the masked fill/drain loop (no slot "
+            "boundary to hoist collectives to) and zb's bit-exact tapped "
+            "twin has no decomposed form yet; use --pipe_schedule 1f1b")
+    model_size = mesh.shape.get(MODEL_AXIS, 1)
+    if compose == "tp":
+        if kernel.tp_fwd is None or kernel.tp_bwd is None:
+            raise ValueError(
+                "pipe×tp needs the task's phased stage kernel "
+                "(PipeStageKernel.tp_fwd / tp_bwd)")
+        if model_size <= 1:
+            raise ValueError(
+                "compose='tp' needs a live model axis (>1) in the mesh")
+        if stage_specs is None:
+            raise ValueError(
+                "compose='tp' needs stage_specs — the per-leaf "
+                "PartitionSpecs placing each stacked block leaf over "
+                "(pipe, model); see parallel.schedule.staged_tp_specs")
+    if compose == "ddp":
+        if grad_comm not in ("fp32", "bf16", "int8"):
+            raise ValueError(
+                f"pipelined_loss: unknown grad_comm {grad_comm!r}")
+        if grad_comm != "fp32" and comm_rng is None:
+            raise ValueError(
+                "compose='ddp' with lossy grad_comm needs comm_rng (the "
+                "per-step key the per-slot stochastic rounding folds "
+                "slot and stage indices into)")
 
     rows = tuple(jnp.asarray(a) for a in
                  (table.work, table.mb, table.aslot,
                   table.gslot, table.arr_f_mb, table.arr_f_slot,
                   table.arr_g_mb, table.arr_g_slot))
+    xs_rows = rows + (jnp.arange(table.n_slots, dtype=jnp.int32),)
     fwd_perm = [(i, (i + 1) % Pn) for i in range(Pn)]
     bwd_perm = [(i, (i - 1) % Pn) for i in range(Pn)]
     psum_axes = (PIPE_AXIS, DATA_AXIS) if data_size > 1 else (PIPE_AXIS,)
 
     from .shard_map_compat import shard_map
+    from .overlap import UNSPLIT, _zero_cotangent
 
-    def per_device(stage_w, tail_p, x_local, tgt_local, wt_local):
+    if compose == "ddp":
+        from .compress import CHUNK as _COMM_CHUNK, _reduce_tree
+    if compose == "fsdp" and data_size > 1:
+        from .sharding import fsdp_split_dim
+
+        def _split_dim(a):
+            # mirror the trainer-side fsdp placement chooser exactly
+            # (same helper, same inputs): the leading stage dim is
+            # pipe-blocked so only trailing dims are free; the largest
+            # data-divisible free dim wins
+            d = fsdp_split_dim(a.shape, data_size, prefer_dim=0,
+                               free=[False] + [True] * (a.ndim - 1))
+            return UNSPLIT if d is None else int(d)
+
+        fsdp_dims = jax.tree.map(_split_dim, stage_params)
+    else:
+        fsdp_dims = jax.tree.map(lambda a: UNSPLIT, stage_params)
+    # full (stage-local, data-unsplit) per-leaf shapes: fsdp branches
+    # close over slot-gathered FULL weights, so their zero-gw default
+    # must be full-shaped, not local-shard-shaped
+    full_sds = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype), stage_params)
+    crng = comm_rng if comm_rng is not None else jax.random.PRNGKey(0)
+
+    def per_device(stage_w, tail_p, x_local, tgt_local, wt_local, key):
         stage_w = jax.tree.map(lambda a: a[0], stage_w)
         p = lax.axis_index(PIPE_AXIS)
         last = p == Pn - 1
@@ -591,6 +712,13 @@ def pipelined_loss(table: PipeTable, kernel: PipeStageKernel,
         def zero_tail():
             return jax.tree.map(jnp.zeros_like, tail_p)
 
+        def zero_gw():
+            # fsdp: vjp runs against slot-gathered FULL weights
+            if compose == "fsdp":
+                return jax.tree.map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), full_sds)
+            return jax.tree.map(jnp.zeros_like, stage_w)
+
         def deltas(y=None, g=None, gw=None, taps=None, dl=None, dh=None,
                    dtail=None):
             """Uniform switch-branch output: only small per-slot values
@@ -599,8 +727,7 @@ def pipelined_loss(table: PipeTable, kernel: PipeStageKernel,
             return (
                 y if y is not None else jnp.zeros(mb_shape, dt),
                 g if g is not None else jnp.zeros(mb_shape, dt),
-                gw if gw is not None else jax.tree.map(
-                    jnp.zeros_like, stage_w),
+                gw if gw is not None else zero_gw(),
                 taps if taps is not None else jax.tree.map(
                     lambda a: jnp.zeros(a.shape, a.dtype), tap_pair0),
                 dl if dl is not None else jnp.zeros((), jnp.float32),
@@ -609,8 +736,9 @@ def pipelined_loss(table: PipeTable, kernel: PipeStageKernel,
             )
 
         def slot(c, xs):
+            t_idx = xs[-1]
             work, mbi, asl, gsl, afm, afs, agm, ags = [
-                _dyn(r, p) for r in xs]
+                _dyn(r, p) for r in xs[:-1]]
             # boundary hops FIRST, consuming last slot's send buffers:
             # dataflow-independent of this slot's compute by
             # construction, so the latency-hiding scheduler may run the
@@ -621,6 +749,18 @@ def pipelined_loss(table: PipeTable, kernel: PipeStageKernel,
             acts = _store_write(c["acts"], afs, recv_y, afm >= 0)
             gys = _store_write(c["gys"], ags, recv_g, agm >= 0)
             mbc = jnp.clip(mbi, 0, M - 1)
+            if compose == "fsdp" and data_size > 1:
+                # slot-boundary gather wave, UNIFORM across stages: the
+                # table is static but the work id is a traced predicate,
+                # so a gather inside the switch would be divergent. Idle
+                # stages gather too — the operand just goes unused.
+                with jax.named_scope("pipe_fsdp_gather"):
+                    w_slot = jax.tree.map(
+                        lambda a, d: a if d == UNSPLIT else lax.all_gather(
+                            a, DATA_AXIS, axis=d - 1, tiled=True),
+                        stage_w, fsdp_dims)
+            else:
+                w_slot = stage_w
 
             def boundary_x():
                 return jnp.where(p == 0, x_local[mbc],
@@ -638,52 +778,119 @@ def pipelined_loss(table: PipeTable, kernel: PipeStageKernel,
 
                 return lax.cond(last, w_tail, wo_tail, None)
 
-            def br_idle():
-                return deltas()
-
-            def br_f():
-                with jax.named_scope("pipe_stage_fwd"):
-                    y = kernel.fwd(stage_w, boundary_x())
-                return deltas(y=y)
-
-            def br_b():  # 1f1b: fused backward, recompute from boundary
-                x = boundary_x()
-                with jax.named_scope("pipe_stage_bwd"):
-                    y, pull = jax.vjp(
-                        lambda w_, x_: kernel.fwd(w_, x_), stage_w, x)
-                    gy, dl, dh, dtail = tail_or_recv(y)
-                    gw, gx = pull(gy)
-                return deltas(g=gx, gw=gw, dl=dl, dh=dh, dtail=dtail)
-
-            def br_bdx():  # zb: dx only; (x, g) taps stashed for dw
-                x = boundary_x()
-                pr0 = jax.tree.map(
-                    lambda a: jnp.zeros(a.shape, a.dtype), probe0)
-                with jax.named_scope("pipe_stage_dx"):
-                    (y, taps), pull = jax.vjp(
-                        lambda x_, pr: kernel.fwd_tapped(stage_w, x_, pr),
-                        x, pr0)
-                    gy, dl, dh, dtail = tail_or_recv(y)
-                    gx, g_probes = pull(
-                        (gy, jax.tree.map(jnp.zeros_like, taps)))
-                return deltas(g=gx, taps=(taps, g_probes), dl=dl, dh=dh,
-                              dtail=dtail)
-
-            if kind == "zb":
-                branches = [br_idle, br_f, br_idle, br_bdx]
-            else:
-                branches = [br_idle, br_f, br_b, br_idle]
-            y_new, g_new, gw_add, tap_new, dl, dh, dtail_add = lax.switch(
-                work, branches)
-
             is_f = work == WORK_F
             is_b = (work == WORK_B) | (work == WORK_BDX)
+
+            if compose == "tp":
+                def psum_model(v):
+                    return lax.psum(v, MODEL_AXIS)
+
+                def guard(fn):
+                    # gate a purely-LOCAL segment on the slot's work id:
+                    # divergent predicate, but collective-free by the
+                    # kernel contract, so divergence is harmless
+                    sds = jax.eval_shape(fn)
+                    return lax.cond(
+                        is_b, fn,
+                        lambda: jax.tree.map(
+                            lambda s: jnp.zeros(s.shape, s.dtype), sds))
+
+                # phased TP slot body: NO switch. The forward sweep runs
+                # unguarded every slot (F slots: the forward; B slots:
+                # the recompute-from-boundary; idle slots: lockstep
+                # waste the bubble already pays for), so its per-layer
+                # model all-reduces issue uniformly across stages. The
+                # tail and the backward's local vjp segments are
+                # guarded; the backward's activation/LN-grad all-reduces
+                # sit BETWEEN the guards at the slot body's top level,
+                # fed zeros by idle stages.
+                xb = boundary_x()
+                with jax.named_scope("pipe_tp_fwd"):
+                    y_new, taps_tp = kernel.tp_fwd(stage_w, xb, psum_model)
+                gy, dl, dh, dtail_add = guard(lambda: tail_or_recv(y_new))
+                with jax.named_scope("pipe_tp_bwd"):
+                    g_new, gw_add = kernel.tp_bwd(
+                        stage_w, taps_tp, gy, psum_model, guard)
+                tap_new = jax.tree.map(
+                    lambda a: jnp.zeros(a.shape, a.dtype), tap_pair0)
+            else:
+                def br_idle():
+                    return deltas()
+
+                def br_f():
+                    with jax.named_scope("pipe_stage_fwd"):
+                        y = kernel.fwd(w_slot, boundary_x())
+                    return deltas(y=y)
+
+                def br_b():  # 1f1b: fused bwd, recompute from boundary
+                    x = boundary_x()
+                    with jax.named_scope("pipe_stage_bwd"):
+                        y, pull = jax.vjp(
+                            lambda w_, x_: kernel.fwd(w_, x_), w_slot, x)
+                        gy, dl, dh, dtail = tail_or_recv(y)
+                        gw, gx = pull(gy)
+                    return deltas(g=gx, gw=gw, dl=dl, dh=dh, dtail=dtail)
+
+                def br_bdx():  # zb: dx only; (x, g) taps stashed for dw
+                    x = boundary_x()
+                    pr0 = jax.tree.map(
+                        lambda a: jnp.zeros(a.shape, a.dtype), probe0)
+                    with jax.named_scope("pipe_stage_dx"):
+                        (y, taps), pull = jax.vjp(
+                            lambda x_, pr: kernel.fwd_tapped(
+                                stage_w, x_, pr),
+                            x, pr0)
+                        gy, dl, dh, dtail = tail_or_recv(y)
+                        gx, g_probes = pull(
+                            (gy, jax.tree.map(jnp.zeros_like, taps)))
+                    return deltas(g=gx, taps=(taps, g_probes), dl=dl,
+                                  dh=dh, dtail=dtail)
+
+                if kind == "zb":
+                    branches = [br_idle, br_f, br_idle, br_bdx]
+                else:
+                    branches = [br_idle, br_f, br_b, br_idle]
+                y_new, g_new, gw_add, tap_new, dl, dh, dtail_add = (
+                    lax.switch(work, branches))
+
             c2 = dict(c)
             c2["acts"] = _store_write(acts, asl, boundary_x(), is_f)
             c2["gys"] = gys
             c2["y_send"] = jnp.where(is_f, y_new, c["y_send"])
             c2["g_send"] = jnp.where(is_b, g_new, c["g_send"])
-            c2["dw"] = jax.tree.map(jnp.add, c["dw"], gw_add)
+            if compose == "ddp" and data_size > 1:
+                # slot-boundary reduce wave: every stage reduces its
+                # per-slot gw over data UNIFORMLY — idle stages feed
+                # zeros (a psum of zeros is correct and uniform, where
+                # a skipped psum is a deadlock). fp32 is exact by
+                # linearity; lossy modes fold (slot, stage) into the
+                # rounding key.
+                key_t = None
+                if grad_comm != "fp32":
+                    key_t = jax.random.fold_in(
+                        jax.random.fold_in(key, t_idx), p)
+                with jax.named_scope("pipe_ddp_reduce"):
+                    gw_red, _ = _reduce_tree(
+                        gw_add, None, key_t, grad_comm, DATA_AXIS,
+                        data_size, _COMM_CHUNK)
+                c2["dw"] = jax.tree.map(jnp.add, c["dw"], gw_red)
+            elif compose == "fsdp" and data_size > 1:
+                # slot-boundary scatter wave: the full per-slot gw
+                # reduces back to each rank's shard (psum_scatter on
+                # split leaves, plain psum on unsplit ones — the same
+                # wave shape on every stage, every slot)
+                with jax.named_scope("pipe_fsdp_scatter"):
+                    gw_loc = jax.tree.map(
+                        lambda g, d: (lax.psum(g, DATA_AXIS)
+                                      if d == UNSPLIT else
+                                      lax.psum_scatter(
+                                          g, DATA_AXIS,
+                                          scatter_dimension=d - 1,
+                                          tiled=True)),
+                        gw_add, fsdp_dims)
+                c2["dw"] = jax.tree.map(jnp.add, c["dw"], gw_loc)
+            else:
+                c2["dw"] = jax.tree.map(jnp.add, c["dw"], gw_add)
             c2["d_tail"] = jax.tree.map(jnp.add, c["d_tail"], dtail_add)
             c2["dx"] = _store_write(c["dx"], mbc, g_new, is_b & (p == 0))
             c2["loss"] = c["loss"] + dl
@@ -695,7 +902,7 @@ def pipelined_loss(table: PipeTable, kernel: PipeStageKernel,
                     c["taps"], tap_new)
             return c2, None
 
-        c, _ = lax.scan(slot, carry, rows)
+        c, _ = lax.scan(slot, carry, xs_rows)
         dw = c["dw"]
         if kind == "zb" and table.wave_units_per_stage:
             # the post-loop dw wave: ONE batched product over every
@@ -709,7 +916,9 @@ def pipelined_loss(table: PipeTable, kernel: PipeStageKernel,
             dw = jax.tree.map(jnp.add, dw, gw)
         loss = lax.psum(c["loss"], psum_axes)
         hits = lax.psum(c["hits"], psum_axes)
-        if data_size > 1:
+        if data_size > 1 and compose not in ("ddp", "fsdp"):
+            # ddp reduced per-slot, fsdp scattered per-slot — both
+            # already carry the cross-data sum
             dw = jax.tree.map(lambda a: lax.psum(a, DATA_AXIS), dw)
         d_tail = jax.tree.map(lambda a: lax.psum(a, psum_axes),
                               c["d_tail"])
@@ -717,24 +926,35 @@ def pipelined_loss(table: PipeTable, kernel: PipeStageKernel,
                 c["dx"][None])
 
     batch_spec = P(None, DATA_AXIS) if data_size > 1 else P()
-    pspec = jax.tree.map(
-        lambda a: P(PIPE_AXIS, *([None] * (a.ndim - 1))), stage_params)
+    if compose == "tp":
+        pspec = stage_specs
+    elif compose == "fsdp" and data_size > 1:
+        def _leafspec(a, d):
+            ents: list[Any] = [None] * (a.ndim - 1)
+            if d != UNSPLIT:
+                ents[d - 1] = DATA_AXIS
+            return P(PIPE_AXIS, *ents)
+
+        pspec = jax.tree.map(_leafspec, stage_params, fsdp_dims)
+    else:
+        pspec = jax.tree.map(
+            lambda a: P(PIPE_AXIS, *([None] * (a.ndim - 1))), stage_params)
     tspec = jax.tree.map(lambda a: P(), tail_params)
     dx_spec = (P(PIPE_AXIS, None, DATA_AXIS) if data_size > 1
                else P(PIPE_AXIS))
     region = shard_map(
         per_device, mesh=mesh,
-        in_specs=(pspec, tspec, batch_spec, batch_spec, batch_spec),
+        in_specs=(pspec, tspec, batch_spec, batch_spec, batch_spec, P()),
         out_specs=(P(), P(), pspec, tspec, dx_spec),
         check_vma=False,
     )
 
-    from .overlap import _zero_cotangent
-
     @jax.custom_vjp
-    def run(stage_w, tail_p, x, tgt, wt):
+    def run(stage_w, tail_p, x, tgt, wt, key):
         # undifferentiated path: the cheap F-only fill/drain loop + the
-        # per-microbatch tail, summed in schedule order
+        # per-microbatch tail, summed in schedule order (model/data
+        # sharded weights are auto-gathered by the GPipe loop's
+        # replicated in_specs — eval-only, so the waste is acceptable)
         ys = pipeline_apply(stage_w, kernel.fwd, x, mesh)
         loss = jnp.zeros((), jnp.float32)
         hits = jnp.zeros((), jnp.float32)
@@ -743,17 +963,19 @@ def pipelined_loss(table: PipeTable, kernel: PipeStageKernel,
             loss, hits = loss + li, hits + hi
         return loss, hits
 
-    def run_fwd(stage_w, tail_p, x, tgt, wt):
-        loss, hits, dw, d_tail, dx = region(stage_w, tail_p, x, tgt, wt)
-        return (loss, hits), (dw, d_tail, dx[0], tgt, wt)
+    def run_fwd(stage_w, tail_p, x, tgt, wt, key):
+        loss, hits, dw, d_tail, dx = region(
+            stage_w, tail_p, x, tgt, wt, key)
+        return (loss, hits), (dw, d_tail, dx[0], tgt, wt, key)
 
     def run_bwd(res, cts):
-        dw, d_tail, dx, tgt, wt = res
+        dw, d_tail, dx, tgt, wt, key = res
         gl, _ = cts  # hits is an argmax count: gradient zero a.e.
         scale = lambda t: jax.tree.map(
             lambda a: (a * gl).astype(a.dtype), t)
         return (scale(dw), scale(d_tail), scale(dx),
-                _zero_cotangent(tgt), _zero_cotangent(wt))
+                _zero_cotangent(tgt), _zero_cotangent(wt),
+                _zero_cotangent(key))
 
     run.defvjp(run_fwd, run_bwd)
-    return run(stage_params, tail_params, x_feed, tgt, wt)
+    return run(stage_params, tail_params, x_feed, tgt, wt, crng)

@@ -135,6 +135,40 @@ def stacked_tp_specs(stacked: Any, mesh: Mesh, *,
     return jax.tree_util.tree_unflatten(treedef, specs)
 
 
+def staged_tp_specs(staged: Any, mesh: Mesh) -> Any:
+    """Per-leaf :class:`PartitionSpec` tree for a pipe-STAGED block tree
+    — leaves shaped ``(n_stages, layers_per_stage, *param)`` — under the
+    Megatron TP layout: the stage dim shards over ``pipe``, the layer
+    dim is replicated, and the trailing dims follow the same
+    ``_BLOCK_LOGICAL_AXES`` placement :func:`stacked_tp_specs` uses.
+    This is the ``stage_specs`` input of
+    ``parallel.pipeline.pipelined_loss(compose='tp')``.
+    """
+    from .sharding import active_rules
+
+    rules = dict(active_rules(mesh))
+    flat, treedef = jax.tree_util.tree_flatten_with_path(staged)
+    specs = []
+    for path, leaf in flat:
+        keys = _path_keys(path)
+        axes = _BLOCK_LOGICAL_AXES.get(keys[-2:]) if len(keys) >= 2 else None
+        if axes is None:
+            raise ValueError(
+                f"staged_tp_specs: unknown block param at path "
+                f"{'/'.join(keys)} — extend _BLOCK_LOGICAL_AXES "
+                "(parallel/schedule.py) with its logical axes so the "
+                "pipelined TP schedule knows its placement"
+            )
+        if leaf.ndim != len(axes) + 2:
+            raise ValueError(
+                f"staged_tp_specs: param {'/'.join(keys)} has ndim "
+                f"{leaf.ndim}, expected {len(axes) + 2} for logical axes "
+                f"{axes} plus the (stage, layer) leading dims"
+            )
+        specs.append(P(PIPE_AXIS, None, *(rules.get(n) for n in axes)))
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
 def spec_mentions(spec: P | None, axis: str) -> bool:
     """True when ``axis`` appears anywhere in a PartitionSpec."""
     for entry in tuple(spec or ()):
@@ -155,15 +189,16 @@ def validate_schedule_mesh(mesh: Mesh | None, *, fsdp: bool = False,
     with the reason named per axis.
 
     The composable sets: ``data`` (fsdp gathers / ddp reduces) ×
-    ``model`` (tp rings) for the decomposed-scan family, and ``pipe`` ×
-    ``data`` for the pipeline slot schedules (``pipe=True`` — the r16
-    fourth contribution, :class:`PipelineSchedule`). ``seq``/``expert``
-    axes need in-region handling no schedule implements. The crosses
-    that are refused stay refused with the reason named: pipe×tp would
-    need the ring kernels traced inside the slot branches (per-shard
-    geometry inside a conditional), and pipe×fsdp/ddp would need the
-    gather/reduce drains threaded through the slot loop's carry — both
-    real designs, neither implemented yet.
+    ``model`` (tp rings) for the decomposed-scan family, and — since
+    r22's boundary-hoisted collective waves — ``pipe`` × ``data`` ×
+    at most ONE of {tp, fsdp, ddp} for the pipeline slot schedules
+    (``pipe=True``): pipe×data×model when ``tp``, pipe×data(param
+    split) when ``fsdp`` or ``ddp``. What stays refused is genuinely
+    impossible or senseless, with the reason named: more than one
+    in-stage decomposition per run (the slot boundary carries one
+    uniform collective wave), a live ``model`` axis without ``tp``
+    (silent unshard), and ``seq``/``expert`` axes which need in-region
+    handling no schedule implements.
     """
     if mesh is None:
         raise ValueError(
@@ -172,17 +207,17 @@ def validate_schedule_mesh(mesh: Mesh | None, *, fsdp: bool = False,
             "mesh= when building directly)"
         )
     if pipe:
-        if fsdp or ddp or tp:
+        n_on = sum((fsdp, ddp, tp))
+        if n_on > 1:
             other = "/".join(n for n, on in (
                 ("fsdp", fsdp), ("ddp", ddp), ("tp", tp)) if on)
             raise ValueError(
-                f"the pipeline slot schedules compose with the data axis "
-                f"only; {other} decomposition inside a pipelined stage "
-                "would need its collectives issued from within the slot "
-                "loop's switch branches (a collective inside a "
-                "divergent-predicate conditional deadlocks on real "
-                "hardware) — drop the overlap flags or use a non-pipe "
-                "entry"
+                f"the pipeline slot schedules compose pipe with exactly "
+                f"ONE in-stage decomposition per run, got {other}: the "
+                "slot boundary carries one uniform collective wave and "
+                "stacking a second would interleave two waves with "
+                "different shapes per stage — drop all but one overlap "
+                "flag"
             )
         if mesh.shape.get(PIPE_AXIS, 1) <= 1:
             raise ValueError(
@@ -190,15 +225,32 @@ def validate_schedule_mesh(mesh: Mesh | None, *, fsdp: bool = False,
                 f">= 2, but the mesh is {dict(mesh.shape)} — add pipe:N "
                 "to --mesh"
             )
+        allowed = ({DATA_AXIS, PIPE_AXIS}
+                   | ({MODEL_AXIS} if tp else set()))
         extra = {name: size for name, size in mesh.shape.items()
-                 if name not in (DATA_AXIS, PIPE_AXIS) and size > 1}
+                 if name not in allowed and size > 1}
         if extra:
+            if MODEL_AXIS in extra and not tp:
+                raise ValueError(
+                    f"mesh has a live '{MODEL_AXIS}' axis ({extra}) but "
+                    "no --tp_overlap: the stage weights would be "
+                    "model-sharded while the slot region specs "
+                    "replicate them — a silent unshard every step; pass "
+                    "--tp_overlap (pipe×tp composes since r22) or drop "
+                    f"the {MODEL_AXIS} axis"
+                )
             raise ValueError(
-                f"the pipeline schedules compose over pipe×data only; "
-                f"mesh also has {extra} — pipe×{'/'.join(extra)} needs "
-                "in-slot handling no schedule implements yet (tp rings "
-                "or fsdp gathers inside the slot branches); drop the "
-                "extra axes"
+                f"the pipeline schedules compose over pipe×data"
+                f"{'×model' if tp else ''} only; mesh also has {extra} "
+                "— these axes need in-region handling no schedule "
+                "implements; drop them"
+            )
+        if tp and mesh.shape.get(MODEL_AXIS, 1) <= 1:
+            raise ValueError(
+                "--tp_overlap under a pipe mesh shards each stage's "
+                f"weights over a '{MODEL_AXIS}' axis, but the mesh is "
+                f"{dict(mesh.shape)} — add model:N to --mesh or drop "
+                "--tp_overlap"
             )
         return mesh
     allowed = {DATA_AXIS} | ({MODEL_AXIS} if tp else set())
@@ -595,18 +647,24 @@ class PipelineSchedule:
     (``obs/hlo_report.check_overlap_expectations``).
 
     Composition today: pipe×data (the microbatch dim shards over
-    ``data`` inside the same region). pipe×tp and pipe×fsdp/ddp are
-    refused with the reason named — see :func:`validate_schedule_mesh`.
+    ``data`` inside the same region) × at most one of tp/fsdp/ddp
+    inside a stage (r22 boundary-hoisted collective waves, 1f1b only —
+    ``pipelined_loss(compose=...)``). Pass the in-stage decomposition
+    flags here so the mesh check matches the run's actual composition;
+    what stays refused is named in :func:`validate_schedule_mesh`.
     """
 
-    def __init__(self, mesh: Mesh, kind: str, n_micro: int):
+    def __init__(self, mesh: Mesh, kind: str, n_micro: int, *,
+                 tp: bool = False, ddp: bool = False, fsdp: bool = False):
         from .pipeline import PIPE_SCHEDULES, build_pipe_table
 
         if kind not in PIPE_SCHEDULES:
             raise ValueError(
                 f"unknown pipe schedule {kind!r}; expected one of "
                 f"{PIPE_SCHEDULES}")
-        validate_schedule_mesh(mesh, pipe=True)
+        validate_schedule_mesh(mesh, pipe=True, tp=tp, ddp=ddp, fsdp=fsdp)
+        self.compose = ("tp" if tp else "ddp" if ddp
+                        else "fsdp" if fsdp else "none")
         self.mesh = mesh
         self.kind = kind
         self.n_micro = n_micro
@@ -629,13 +687,44 @@ class PipelineSchedule:
         stage: the fused slot loops issue TWO ppermutes per slot (fwd
         activation down + bwd grad up), gpipe's masked loop ONE per
         tick (fwd ticks send activations; the AD-transposed backward
-        ticks send grads)."""
+        ticks send grads). In-stage compose waves (tp all-reduces, ddp
+        reduces, fsdp gather/scatter) ride the *other* axes and are
+        accounted by their own helpers
+        (``collective_matmul.tp_wire_bytes_per_step`` et al.)."""
         buf = mb * seq * embed * itemsize
         if self.table is not None:
             hops = 2 * self.table.n_slots
         else:
             hops = 2 * (self.n_micro + self.n_stages - 1)
         return hops * self.n_stages * buf
+
+    def tp_wave_bytes_per_step(self, mb: int, seq: int, embed: int,
+                               layers_per_stage: int, model: int,
+                               itemsize: int = 4) -> int:
+        """Static MODEL-axis wire estimate for the r22 pipe×tp compose
+        wave, per training step across all stages.
+
+        The psum-form Megatron stage (models/gpt_pipe.py) issues two
+        model-axis all-reduces per layer in the forward sweep — which
+        runs EVERY slot (on B slots it is the recompute) — and two more
+        per layer in the guarded backward segments of each B slot (one
+        B slot per microbatch per stage). Each ring all-reduce moves
+        ``2(n-1)/n`` × the ``(mb, seq, embed)`` activation per
+        participant. This is the figure ``obs/attribution.py``'s
+        ``static_cost_model`` uses to split the shared all-reduce
+        census between the data and model axes on pipe×tp meshes —
+        an estimate for attribution, not an exactness contract.
+        """
+        if model <= 1:
+            return 0
+        buf = mb * seq * embed * itemsize
+        if self.table is not None:
+            slots = self.table.n_slots
+        else:
+            slots = 2 * (self.n_micro + self.n_stages - 1)
+        psums = 2 * layers_per_stage * (slots + self.n_micro)
+        per_rank = 2 * (model - 1) / model
+        return int(psums * self.n_stages * buf * per_rank)
 
 
 # -- composed-schedule HLO evidence ----------------------------------------

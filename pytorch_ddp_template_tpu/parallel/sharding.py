@@ -298,7 +298,11 @@ def describe(mesh: Mesh, config: Any = None,
                         seq, embed = int(wpe.shape[0]), int(wpe.shape[1])
                         mb = max(per_replica // max(eff, 1), 1)
                         wire = PipelineSchedule(
-                            mesh, sched, max(eff, 1)).wire_bytes_per_step(
+                            mesh, sched, max(eff, 1),
+                            tp=getattr(config, "tp_overlap", False),
+                            ddp=getattr(config, "ddp_overlap", False),
+                            fsdp=getattr(config, "fsdp_overlap", False),
+                        ).wire_bytes_per_step(
                                 mb, seq, embed,
                                 itemsize=2 if getattr(config, "bf16",
                                                       False) else 4)

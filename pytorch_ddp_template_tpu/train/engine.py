@@ -1897,9 +1897,21 @@ class Trainer:
                 pipe_bubble = float(bf(self.config.train_batch_size))
             except Exception:  # noqa: BLE001 - attribution only
                 pipe_bubble = 0.0
+        # r22: on pipe×tp meshes the model-axis psums share the
+        # all-reduce spelling with the data grad reduce — the task's
+        # static ring-wire figure lets the cost model split the census
+        # between the axes (zero everywhere else)
+        model_wire = 0.0
+        mw = getattr(self.task, "model_wire_bytes_per_step", None)
+        if callable(mw):
+            try:
+                model_wire = float(mw(self.config.train_batch_size))
+            except Exception:  # noqa: BLE001 - attribution only
+                model_wire = 0.0
         cost_model = static_cost_model(
             compiled, dict(self.ctx.mesh.shape), hlo_text=hlo_text,
-            pipe_bubble_frac=pipe_bubble)
+            pipe_bubble_frac=pipe_bubble,
+            model_wire_bytes_per_step=model_wire)
         devices = self.ctx.mesh.devices
         self.perf = PerfAttribution(
             cost_model,

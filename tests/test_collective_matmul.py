@@ -344,9 +344,9 @@ class TestRefusals:
         # MoE: expert dispatch needs in-region handling
         with pytest.raises(ValueError, match="MoE"):
             build("gpt-moe-tiny", cfg("gpt-moe-tiny"), mesh=tp_mesh)
-        # gpt-pipe: pipe×tp refused with the slot-loop reason named
-        # (r16 — --scan_layers itself is now the stage-local scan)
-        with pytest.raises(ValueError, match="pipelined entries"):
+        # r22: pipe×tp now COMPOSES (boundary-hoisted psums) — the
+        # remaining refusal on a pipe-less mesh is the missing pipe axis
+        with pytest.raises(ValueError, match="pipe"):
             build("gpt-pipe-tiny", cfg("gpt-pipe-tiny"), mesh=tp_mesh)
 
     def test_geometry_level(self, devices):

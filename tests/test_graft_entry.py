@@ -10,6 +10,7 @@ import sys
 import time
 
 import jax
+import pytest
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _ROOT)
@@ -23,6 +24,7 @@ def test_entry_jits_and_runs():
     assert out.shape == (16, 1000)  # resnet50 logits
 
 
+@pytest.mark.slow  # 8-device compile; /verify drives the hook directly
 def test_dryrun_multichip_8_devices_under_budget():
     import __graft_entry__ as graft
 
@@ -46,6 +48,7 @@ def _run_bench(env_overrides: dict) -> subprocess.CompletedProcess:
     )
 
 
+@pytest.mark.slow  # bench subprocess; the per-mode contract tests stay tier-1
 def test_bench_main_prints_valid_json_on_cpu():
     proc = _run_bench({})
     assert proc.returncode == 0, proc.stderr[-2000:]

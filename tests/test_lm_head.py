@@ -142,6 +142,7 @@ def test_bert_fused_head_equals_dense_task():
         g_d, g_f)
 
 
+@pytest.mark.slow  # ~14s composed compile; the blockwise parity units stay tier-1
 def test_fused_head_under_tensor_parallel_vocab_sharding(tmp_path):
     """On a data:4,model:2 mesh the tied table is sharded over ``model``
     on its vocab dim; the blockwise head's dynamic_slice then runs over a
@@ -174,6 +175,7 @@ def test_fused_head_under_tensor_parallel_vocab_sharding(tmp_path):
     np.testing.assert_allclose(acc_d, acc_f, rtol=1e-6)
 
 
+@pytest.mark.slow  # ~16s accum-scan compile; the blockwise parity units stay tier-1
 def test_fused_head_inside_accum_scan(tmp_path):
     """Gradient accumulation runs task.loss inside an in-jit lax.scan —
     the fused head's own vocab scan then nests inside it. accum=2 must

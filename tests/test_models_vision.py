@@ -133,6 +133,7 @@ class TestRegistryVision:
         names = available_models()
         assert "resnet18" in names and "resnet50" in names
 
+    @pytest.mark.slow  # ~30s full resnet train; registry/shape units stay tier-1
     def test_resnet18_trains_sharded(self, tmp_path):
         cfg = TrainingConfig(
             model="resnet18", output_dir=str(tmp_path), max_steps=2,

@@ -132,6 +132,7 @@ class TestLoadBalanceLoss:
 
 
 class TestZero1Composition:
+    @pytest.mark.slow  # full moe+zero1 train; spec/dispatch units stay tier-1
     def test_moe_trains_with_zero1_optimizer_sharding(self, tmp_path):
         """ZeRO-1 (opt state sharded over data) composed with expert-
         sharded MoE weights: one step must run and descend-capable state

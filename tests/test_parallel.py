@@ -222,6 +222,7 @@ def test_tensor_parallel_loss_matches_replicated():
     assert abs(base - tp) < 1e-4, (base, tp)
 
 
+@pytest.mark.slow  # full bert-long train; ring-attention parity units stay tier-1
 def test_context_parallel_end_to_end(tmp_path):
     """bert-long-tiny (ring attention, seq-sharded batch) trains through
     the full Trainer on a data×seq mesh and the loss decreases."""

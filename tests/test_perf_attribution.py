@@ -64,6 +64,81 @@ class TestStaticCostModel:
         assert data_only["wire_bytes_data"] == 4096
         assert data_only["wire_bytes_model"] == 0
 
+    def test_wire_split_on_pipe_tp_mesh(self):
+        """r22 satellite: with a model axis live ON a pipe mesh
+        (pipe×tp), the TP psums share the all-reduce spelling with the
+        data-axis reduce — the split comes from the caller's static
+        ring-wire figure, clamped to the census; ppermute bytes go to
+        the pipe bucket, never model."""
+        hlo = "\n".join([
+            "body1 (a: f32[]) -> f32[] {",
+            "  %ar = f32[1024]{0} all-reduce(%p), to_apply=%add",
+            "  %r = f32[512]{0} collective-permute(%q), src={{0,1}}",
+            "}",
+        ])
+
+        class FakeCompiled:
+            def cost_analysis(self):
+                raise RuntimeError("no backend")
+
+        axes = {"data": 2, "model": 2, "pipe": 2}
+        cm = static_cost_model(FakeCompiled(), axes, hlo_text=hlo,
+                               model_wire_bytes_per_step=1000)
+        assert cm["wire_bytes_model"] == 1000   # the static figure
+        assert cm["wire_bytes_data"] == 4096 - 1000  # the remainder
+        assert cm["wire_bytes_pipe"] == 2048    # boundary hops
+        assert cm["wire_bytes_total"] == 4096 + 2048
+        # the figure is an estimate: clamp to what the census carries
+        big = static_cost_model(FakeCompiled(), axes, hlo_text=hlo,
+                                model_wire_bytes_per_step=10 ** 9)
+        assert big["wire_bytes_model"] == 4096
+        assert big["wire_bytes_data"] == 0
+        # pipe×ddp: no model axis → the figure is inert, gather → data
+        ddp = static_cost_model(FakeCompiled(),
+                                {"data": 4, "pipe": 2}, hlo_text=hlo,
+                                model_wire_bytes_per_step=1000)
+        assert ddp["wire_bytes_model"] == 0
+        assert ddp["wire_bytes_data"] == 4096
+        assert ddp["wire_bytes_pipe"] == 2048
+        # off pipe meshes the parameter is ignored: r11 families stand
+        flat = static_cost_model(FakeCompiled(),
+                                 {"data": 4, "model": 2}, hlo_text=hlo,
+                                 model_wire_bytes_per_step=1000)
+        assert flat["wire_bytes_model"] == 2048  # ring family
+        assert flat["wire_bytes_data"] == 4096
+        assert flat["wire_bytes_pipe"] == 0
+
+    def test_pipe_bubble_overlay_with_model_axis_live(self):
+        """perf_bubble_frac = device share × static bubble must hold
+        unchanged at pipe×tp geometry (model axis live), the fraction
+        quartet still summing to 1.0, and describe() carrying the pipe
+        wire figure."""
+        class _NoCost:
+            def cost_analysis(self):
+                return {}
+
+        hlo = "\n".join([
+            "body1 (a: f32[]) -> f32[] {",
+            "  %ar = f32[1024]{0} all-reduce(%p), to_apply=%add",
+            "  %r = f32[512]{0} collective-permute(%q), src={{0,1}}",
+            "}",
+        ])
+        cm = static_cost_model(_NoCost(), {"data": 2, "model": 2,
+                                           "pipe": 2},
+                               hlo_text=hlo, pipe_bubble_frac=0.4,
+                               model_wire_bytes_per_step=1000)
+        assert cm["pipe_bubble_frac"] == 0.4
+        perf = PerfAttribution(cm, device_kind="host", n_devices=8)
+        rec = perf.interval(wall_s=10.0, steps=10, input_wait_s=1.0,
+                            device_wait_s=5.0)
+        assert rec["perf_bubble_frac"] == pytest.approx(0.5 * 0.4,
+                                                        abs=1e-3)
+        quartet = (rec["perf_frac_input"] + rec["perf_frac_host"]
+                   + rec["perf_frac_comm"] + rec["perf_frac_compute"])
+        assert quartet == pytest.approx(1.0, abs=1e-6)
+        desc = perf.describe()
+        assert desc["wire_mb_per_step_pipe"] == round(2048 / 1e6, 3)
+
     def test_cost_of_never_raises(self):
         class Broken:
             def cost_analysis(self):

@@ -267,35 +267,53 @@ def test_pipelined_entry_checkpoint_resume(tmp_path):
 
 
 def test_pipelined_entry_refusal_matrix():
-    """r16: the pipeline composes with plain data parallelism only —
-    every overlap-flag cross is refused at build time with the reason
-    named (the crosses are real designs, just not implemented; silently
-    unsharding stage weights or issuing collectives inside the slot
-    loop's divergent conditionals would be worse than refusing)."""
+    """r22: the refusal matrix shrank to the genuinely-impossible
+    combos. pipe×{tp,ddp,fsdp} BUILD (one compose wave per run, hoisted
+    to the slot boundary — parallel/pipeline.py); what stays refused,
+    with the reason named: plain GSPMD --fsdp (silent re-gather), more
+    than one compose flag, compose on a non-1f1b schedule, and
+    --grad_error_feedback (no per-step residual thread through the
+    slot loop)."""
     from pytorch_ddp_template_tpu.config import TrainingConfig
     from pytorch_ddp_template_tpu.models import build
 
+    # the lifted crosses: each compose flag builds on its mesh
+    builds = [
+        (dict(tp_overlap=True, scan_layers=True), "data:2,model:2,pipe:2"),
+        (dict(ddp_overlap=True), "data:4,pipe:2"),
+        (dict(fsdp_overlap=True, scan_layers=True), "data:4,pipe:2"),
+    ]
+    for kwargs, spec in builds:
+        cfg = TrainingConfig(model="gpt-pipe-tiny", mesh=spec, **kwargs)
+        mesh = make_mesh(spec, jax.devices())
+        task, _ = build(cfg.model, cfg, mesh=mesh)
+        assert task is not None
+
+    # what remains refused, with intent
     mesh = make_mesh("data:4,pipe:2", jax.devices())
     cases = [
-        (dict(fsdp=True), "--fsdp"),
-        (dict(fsdp_overlap=True, scan_layers=True), "--fsdp_overlap"),
-        (dict(ddp_overlap=True, scan_layers=True), "--ddp_overlap"),
-        (dict(tp_overlap=True, scan_layers=True), "--tp_overlap"),
+        (dict(fsdp=True), "--fsdp", "data:4,pipe:2"),
+        (dict(tp_overlap=True, ddp_overlap=True, scan_layers=True),
+         "ONE", "data:2,model:2,pipe:2"),
+        (dict(ddp_overlap=True, pipe_schedule="gpipe"), "1f1b",
+         "data:4,pipe:2"),
+        (dict(ddp_overlap=True, grad_comm="int8",
+              grad_error_feedback=True), "--grad_error_feedback",
+         "data:4,pipe:2"),
     ]
-    for kwargs, flag in cases:
-        cfg = TrainingConfig(model="gpt-pipe-tiny", mesh="data:4,pipe:2",
-                             **kwargs)
+    for kwargs, needle, spec in cases:
+        cfg = TrainingConfig(model="gpt-pipe-tiny", mesh=spec, **kwargs)
         with pytest.raises(ValueError) as e:
-            build(cfg.model, cfg, mesh=mesh)
-        assert flag in str(e.value)
-        assert "pipe" in str(e.value)
+            build(cfg.model, cfg, mesh=make_mesh(spec, jax.devices()))
+        assert needle in str(e.value)
 
 
 def test_validate_schedule_mesh_pipe():
-    """The fourth schedule contribution's mesh validation
-    (parallel/schedule.py): pipe×data composes; pipe×model and
-    pipe-with-overlap-flags are refused with named reasons; a pipe-less
-    mesh has nothing to schedule."""
+    """The schedule's mesh validation (parallel/schedule.py), r22 form:
+    pipe×data composes; pipe×data×model composes WITH tp=True and
+    pipe×data with ddp/fsdp=True; a model axis without tp, multiple
+    compose flags, tp without a model axis and a pipe-less mesh are
+    refused with named reasons."""
     from pytorch_ddp_template_tpu.parallel.schedule import (
         PipelineSchedule, validate_schedule_mesh,
     )
@@ -306,14 +324,25 @@ def test_validate_schedule_mesh_pipe():
     assert sched.n_stages == 2
     assert 0.0 < sched.bubble_fraction() < 1.0
     assert sched.wire_bytes_per_step(4, 128, 64) > 0
-    with pytest.raises(ValueError, match="fsdp"):
-        validate_schedule_mesh(mesh, pipe=True, fsdp=True)
+    # r22 compose acceptances
+    assert validate_schedule_mesh(mesh, pipe=True, ddp=True) is mesh
+    assert validate_schedule_mesh(mesh, pipe=True, fsdp=True) is mesh
+    tp_mesh = make_mesh("data:2,model:2,pipe:2", jax.devices())
+    assert validate_schedule_mesh(tp_mesh, pipe=True, tp=True) is tp_mesh
+    sched_tp = PipelineSchedule(tp_mesh, "1f1b", 4, tp=True)
+    assert sched_tp.compose == "tp"
+    assert sched_tp.tp_wave_bytes_per_step(4, 32, 16, 2, 2) > 0
+    assert sched_tp.tp_wave_bytes_per_step(4, 32, 16, 2, 1) == 0
+    # what stays refused, with intent
     with pytest.raises(ValueError, match="pipe"):
         validate_schedule_mesh(make_mesh("data:8", jax.devices()),
                                pipe=True)
-    bad = make_mesh("data:2,model:2,pipe:2", jax.devices())
     with pytest.raises(ValueError, match="model"):
-        validate_schedule_mesh(bad, pipe=True)
+        validate_schedule_mesh(tp_mesh, pipe=True)  # live model, no tp
+    with pytest.raises(ValueError, match="model"):
+        validate_schedule_mesh(mesh, pipe=True, tp=True)  # tp, no model
+    with pytest.raises(ValueError, match="ONE|one"):
+        validate_schedule_mesh(tp_mesh, pipe=True, tp=True, ddp=True)
     with pytest.raises(ValueError, match="pipe schedule"):
         PipelineSchedule(mesh, "nope", 4)
 
@@ -454,6 +483,135 @@ class TestPipeTables:
             build_pipe_table("gpipe", 4, 2)  # masked loop has no table
         with pytest.raises(ValueError, match="n_micro"):
             build_pipe_table("zb", 0, 2)
+
+
+class TestPipeTableInternals:
+    """r22 satellite: the first direct pins on build_pipe_table's
+    intermediate structures — arrival maps, store-slot interval
+    packing, and the bubble model under MEASURED (non-unit) branch
+    costs. Host-side numpy only."""
+
+    @staticmethod
+    def _placements(tab):
+        """Recover (f_slot, b_slot) from the work/mb rows."""
+        from pytorch_ddp_template_tpu.parallel.pipeline import (
+            WORK_B, WORK_BDX, WORK_F,
+        )
+
+        M, P = tab.n_micro, tab.n_stages
+        f = np.full((P, M), -1, np.int64)
+        b = np.full((P, M), -1, np.int64)
+        for t in range(tab.n_slots):
+            for p in range(P):
+                w = int(tab.work[t, p])
+                if w == WORK_F:
+                    f[p, int(tab.mb[t, p])] = t
+                elif w in (WORK_B, WORK_BDX):
+                    b[p, int(tab.mb[t, p])] = t
+        return f, b
+
+    @pytest.mark.parametrize("kind", ["1f1b", "zb"])
+    @pytest.mark.parametrize("mp", [(2, 2), (4, 3), (8, 2), (3, 4)])
+    def test_arrival_maps_mirror_placements(self, kind, mp):
+        """A unit produced at slot t is consumable downstream from
+        t+1: arr_f_mb[f_slot[p,i]+1, p+1] == i, grads symmetrically
+        upstream — stage 0's fwd wire and the last stage's grad wire
+        stay -1, and every microbatch arrives exactly once per wire."""
+        from pytorch_ddp_template_tpu.parallel.pipeline import (
+            build_pipe_table,
+        )
+
+        M, P = mp
+        tab = build_pipe_table(kind, M, P)
+        f, b = self._placements(tab)
+        for p in range(P):
+            for i in range(M):
+                if p + 1 < P:
+                    assert tab.arr_f_mb[f[p, i] + 1, p + 1] == i
+                if p > 0 and b[p, i] + 1 < tab.n_slots:
+                    assert tab.arr_g_mb[b[p, i] + 1, p - 1] == i
+        assert np.all(tab.arr_f_mb[:, 0] == -1)
+        assert np.all(tab.arr_g_mb[:, P - 1] == -1)
+        for p in range(1, P):
+            got = sorted(int(i) for i in tab.arr_f_mb[:, p] if i >= 0)
+            assert got == list(range(M))
+        for p in range(P - 1):
+            got = [int(i) for i in tab.arr_g_mb[:, p] if i >= 0]
+            assert len(got) == len(set(got))  # at most once per wire
+
+    @pytest.mark.parametrize("kind", ["1f1b", "zb"])
+    @pytest.mark.parametrize("mp", [(2, 2), (4, 3), (8, 2)])
+    def test_store_slot_packing_no_live_collisions(self, kind, mp):
+        """Interval packing: two microbatches whose activation
+        lifetimes [arrive, consume] overlap at a stage must hold
+        DISTINCT aslots, every assignment stays < n_aslots, and a
+        freed slot is reusable (n_aslots <= min(M, live bound))."""
+        from pytorch_ddp_template_tpu.parallel.pipeline import (
+            WORK_B, WORK_BDX, WORK_F, build_pipe_table,
+        )
+
+        M, P = mp
+        tab = build_pipe_table(kind, M, P)
+        f, b = self._placements(tab)
+        # recover each (p, i) -> aslot from the work rows
+        amap = {}
+        for t in range(tab.n_slots):
+            for p in range(P):
+                if int(tab.work[t, p]) in (WORK_F, WORK_B, WORK_BDX):
+                    key = (p, int(tab.mb[t, p]))
+                    s = int(tab.aslot[t, p])
+                    assert 0 <= s < tab.n_aslots
+                    assert amap.setdefault(key, s) == s  # stable
+        for p in range(P):
+            for i in range(M):
+                for j in range(i + 1, M):
+                    lo_i = f[p, i] if p == 0 else f[p - 1, i] + 1
+                    lo_j = f[p, j] if p == 0 else f[p - 1, j] + 1
+                    if lo_i <= b[p, j] and lo_j <= b[p, i]:
+                        assert amap[(p, i)] != amap[(p, j)]
+        assert tab.n_aslots <= M or M == 1
+
+    def test_arrival_slot_points_at_consumer_store(self):
+        """arr_f_slot names the STORE slot the arriving activation
+        lands in — it must equal the consumer stage's packed aslot for
+        that microbatch (the wire and the store agree)."""
+        from pytorch_ddp_template_tpu.parallel.pipeline import (
+            WORK_B, WORK_BDX, WORK_F, build_pipe_table,
+        )
+
+        tab = build_pipe_table("1f1b", 4, 3)
+        amap = {}
+        for t in range(tab.n_slots):
+            for p in range(tab.n_stages):
+                if int(tab.work[t, p]) in (WORK_F, WORK_B, WORK_BDX):
+                    amap[(p, int(tab.mb[t, p]))] = int(tab.aslot[t, p])
+        for t in range(tab.n_slots):
+            for p in range(tab.n_stages):
+                i = int(tab.arr_f_mb[t, p])
+                if i >= 0:
+                    assert int(tab.arr_f_slot[t, p]) == amap[(p, i)]
+
+    def test_bubble_fraction_consistent_with_makespan(self):
+        """schedule_bubble_fraction is exactly 1 - useful/(P*span) of
+        schedule_makespan under the SAME measured costs — the bench
+        legs rely on this identity when they feed device-measured
+        branch times into the static model."""
+        from pytorch_ddp_template_tpu.parallel.pipeline import (
+            WORK_B, WORK_BDX, WORK_BDW, WORK_F, schedule_bubble_fraction,
+            schedule_makespan,
+        )
+
+        measured = {WORK_F: 1.7, WORK_B: 4.2, WORK_BDX: 2.9,
+                    WORK_BDW: 1.3}
+        for kind in ("gpipe", "1f1b", "zb"):
+            span, useful = schedule_makespan(kind, 4, 3, measured)
+            frac = schedule_bubble_fraction(kind, 4, 3, measured)
+            assert frac == pytest.approx(1.0 - useful / (3 * span))
+            assert 0.0 < frac < 1.0
+        # skewed costs keep the ordering the unit model predicts
+        zb = schedule_bubble_fraction("zb", 4, 3, measured)
+        f1 = schedule_bubble_fraction("1f1b", 4, 3, measured)
+        assert zb < f1
 
 
 class TestZbTappedBlock:
@@ -599,6 +757,109 @@ class TestFusedScheduleParity:
         task = self._build("zb")
         total, _, m = task.loss(params, {}, batch, None, train=False)
         assert float(total) == pytest.approx(l_ref, rel=1e-5)
+
+
+class TestComposedScheduleParity:
+    """r22 tentpole pin: pipe×tp, pipe×ddp and pipe×fsdp loss/grad
+    parity against the gpipe baseline (itself pinned against sequential
+    stages above) — same float32 conventions, and the compiled slot
+    body must carry ZERO collectives inside branch_computations (the
+    boundary-hoisting invariant; a divergent-branch collective is a
+    deadlock on real hardware, so this tripwire is the acceptance
+    gate, not decoration)."""
+
+    KW = dict(vocab_size=256, seq_len=32, num_layers=2, num_heads=2,
+              head_dim=8, mlp_dim=32, n_micro=2)
+
+    def _build(self, compose, **extra):
+        from pytorch_ddp_template_tpu.models.gpt_pipe import (
+            PipelinedGptTask,
+        )
+
+        if compose == "tp":
+            mesh = make_mesh("data:2,model:2,pipe:2", jax.devices())
+        else:
+            mesh = make_mesh("data:2,pipe:2", jax.devices()[:4])
+        flags = {}
+        if compose != "none":
+            flags[f"{compose}_overlap"] = True
+        return PipelinedGptTask(mesh, pipe_schedule="1f1b",
+                                **flags, **extra, **self.KW)
+
+    @pytest.fixture(scope="class")
+    def reference(self):
+        import flax.linen as nn
+
+        from pytorch_ddp_template_tpu.models.gpt_pipe import (
+            PipelinedGptTask,
+        )
+
+        mesh = make_mesh("data:2,pipe:2", jax.devices()[:4])
+        task = PipelinedGptTask(mesh, pipe_schedule="gpipe", **self.KW)
+        ids = np.asarray(np.random.default_rng(6).integers(
+            0, 256, (4, 32)), np.int32)
+        batch = {"input_ids": ids}
+        params, _ = task.init(jax.random.PRNGKey(7), batch)
+        params = nn.meta.unbox(params)
+
+        def f(p):
+            total, _, _ = task.loss(p, {}, batch, None, train=True)
+            return total
+
+        l, g = jax.jit(jax.value_and_grad(f))(params)
+        return batch, params, float(l), jax.device_get(g)
+
+    @pytest.mark.parametrize("compose", ["tp", "ddp", "fsdp"])
+    def test_loss_and_grads_match_gpipe(self, reference, compose):
+        batch, params, l_ref, g_ref = reference
+        task = self._build(compose)
+
+        def f(p):
+            total, _, _ = task.loss(p, {}, batch, None, train=True)
+            return total
+
+        fn = jax.jit(jax.value_and_grad(f))
+        l, g = fn(params)
+        assert float(l) == pytest.approx(l_ref, rel=1e-6)
+        g = jax.device_get(g)
+        flat_r, _ = jax.tree_util.tree_flatten_with_path(g_ref)
+        for (path, a), b in zip(flat_r, jax.tree.leaves(g)):
+            a, b = np.asarray(a), np.asarray(b)
+            scale = max(float(np.max(np.abs(a))), 1e-6)
+            assert float(np.max(np.abs(a - b))) / scale < 2e-4, \
+                jax.tree_util.keystr(path)
+
+        # the r22 invariant on the REAL lowering: conditionals present
+        # (the work switch for ddp/fsdp; guard conds for tp), zero
+        # collectives reachable from their branch computations
+        from pytorch_ddp_template_tpu.obs.hlo_report import pipe_evidence
+
+        ev = pipe_evidence(fn.lower(params).compile().as_text())
+        assert ev["slot_bodies"] >= 1
+        assert ev["pipe_sends_independent"] is True
+        assert ev["branch_computation_count"] >= 1
+        assert ev["branch_collectives"] == 0
+        assert ev["branch_collectives_free"] is True
+
+    def test_ddp_lossy_wire_stays_close(self, reference):
+        """grad_comm=bf16 per-slot reduces: stochastic rounding is
+        unbiased, so the grads stay within a loose band of the fp32
+        reference (the exact-parity bar is fp32's)."""
+        batch, params, l_ref, g_ref = reference
+        task = self._build("ddp", grad_comm="bf16")
+
+        def f(p):
+            total, _, _ = task.loss(p, {}, batch,
+                                    jax.random.PRNGKey(11), train=True)
+            return total
+
+        l, g = jax.jit(jax.value_and_grad(f))(params)
+        assert float(l) == pytest.approx(l_ref, rel=1e-6)
+        g = jax.device_get(g)
+        for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g)):
+            a, b = np.asarray(a), np.asarray(b)
+            scale = max(float(np.max(np.abs(a))), 1e-6)
+            assert float(np.max(np.abs(a - b))) / scale < 5e-2
 
 
 def test_effective_microbatches_and_bubble_surface():
@@ -839,6 +1100,103 @@ ENTRY %main (x: f32[4,4]) -> f32[4,4] {
         ev = pipe_evidence(self.BAD_VIA_COND)
         assert ev["slot_bodies"] == 1
         assert ev["pipe_sends_independent"] is False
+
+    BAD_BRANCH_COLL = """
+HloModule bad3
+%add (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %s = f32[] add(%a, %b)
+}
+%branch_w (p: f32[4,4]) -> f32[4,4] {
+  %p = f32[4,4] parameter(0)
+  %ar = f32[4,4] all-reduce(%p), replica_groups={}, to_apply=%add
+  ROOT %d = f32[4,4] dot(%ar, %ar)
+}
+%body (arg: (f32[4,4], s32[])) -> (f32[4,4], s32[]) {
+  %arg = (f32[4,4], s32[]) parameter(0)
+  %y = f32[4,4] get-tuple-element(%arg), index=0
+  %i = s32[] get-tuple-element(%arg), index=1
+  %send = f32[4,4] collective-permute(%y), source_target_pairs={{0,1}}
+  %w = f32[4,4] conditional(%i, %send, %send), branch_computations={%branch_w, %branch_w}
+  ROOT %t = (f32[4,4], s32[]) tuple(%w, %i)
+}
+ENTRY %main (x: f32[4,4]) -> f32[4,4] {
+  %x = f32[4,4] parameter(0)
+  ROOT %r = f32[4,4] dot(%x, %x)
+}
+"""
+
+    BAD_BRANCH_COLL_NESTED = """
+HloModule bad4
+%add (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %s = f32[] add(%a, %b)
+}
+%inner (q: f32[4,4]) -> f32[4,4] {
+  %q = f32[4,4] parameter(0)
+  ROOT %ar = f32[4,4] all-reduce(%q), replica_groups={}, to_apply=%add
+}
+%branch_w (p: f32[4,4]) -> f32[4,4] {
+  %p = f32[4,4] parameter(0)
+  %c = f32[4,4] call(%p), to_apply=%inner
+  ROOT %d = f32[4,4] dot(%c, %c)
+}
+%body (arg: (f32[4,4], s32[])) -> (f32[4,4], s32[]) {
+  %arg = (f32[4,4], s32[]) parameter(0)
+  %y = f32[4,4] get-tuple-element(%arg), index=0
+  %i = s32[] get-tuple-element(%arg), index=1
+  %send = f32[4,4] collective-permute(%y), source_target_pairs={{0,1}}
+  %w = f32[4,4] conditional(%i, %send, %send), branch_computations={%branch_w, %branch_w}
+  ROOT %t = (f32[4,4], s32[]) tuple(%w, %i)
+}
+ENTRY %main (x: f32[4,4]) -> f32[4,4] {
+  %x = f32[4,4] parameter(0)
+  ROOT %r = f32[4,4] dot(%x, %x)
+}
+"""
+
+    def test_branch_collective_counts(self):
+        """The r22 compose invariant: GOOD's branches hold only dots
+        (free); a direct all-reduce under the predicate counts; so
+        does one reached transitively through a called computation —
+        the closure matters because XLA freely outlines branch bodies
+        into helper computations."""
+        from pytorch_ddp_template_tpu.obs.hlo_report import pipe_evidence
+
+        good = pipe_evidence(self.GOOD)
+        assert good["branch_computation_count"] >= 1
+        assert good["branch_collectives"] == 0
+        assert good["branch_collectives_free"] is True
+
+        direct = pipe_evidence(self.BAD_BRANCH_COLL)
+        assert direct["branch_collectives"] == 1
+        assert direct["branch_collectives_free"] is False
+
+        nested = pipe_evidence(self.BAD_BRANCH_COLL_NESTED)
+        assert nested["branch_collectives"] == 1
+        assert nested["branch_collectives_free"] is False
+
+    def test_branch_collective_tripwire_warns(self):
+        """check_overlap_expectations surfaces the deadlock shape as a
+        named warning on pipelined configs — and stays quiet on GOOD."""
+        from types import SimpleNamespace
+
+        from pytorch_ddp_template_tpu.obs.hlo_report import (
+            check_overlap_expectations, schedule_report,
+        )
+
+        cfg = SimpleNamespace(model="gpt-pipe-tiny", pipe_schedule="1f1b",
+                              fsdp_overlap=False, ddp_overlap=True,
+                              tp_overlap=False)
+        axes = {"data": 2, "pipe": 2}
+        warns = check_overlap_expectations(
+            schedule_report(self.BAD_BRANCH_COLL), cfg, axes)
+        assert any("branch_computations" in w for w in warns)
+        ok = check_overlap_expectations(
+            schedule_report(self.GOOD), cfg, axes)
+        assert not any("branch_computations" in w for w in ok)
 
     def test_tripwire_gating(self):
         """check_overlap_expectations: the pipe check fires only for a

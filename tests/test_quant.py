@@ -289,6 +289,7 @@ def test_quant_block_param_tree_interchangeable_and_close(devices, mode):
     assert rel < 10 * TOL_REL[mode]  # grads amplify through the stack
 
 
+@pytest.mark.slow  # ~15s scan×tp compile; per-mode quant parity stays tier-1
 def test_quant_composes_with_scan_and_tp(devices):
     mesh = make_mesh("data:4,model:2", jax.devices())
     l, g, _ = _gpt_tiny_loss_and_grad(

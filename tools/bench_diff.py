@@ -44,7 +44,7 @@ import sys
 ABLATION_KEYS = ("remat", "fused_head", "dense_head", "flash_disabled",
                  "num_layers", "scan_layers", "ddp_overlap", "tp_overlap",
                  "fsdp_overlap", "quant_compute", "kv_quant", "paged_impl",
-                 "spec_k", "draft_depth", "tp_degree")
+                 "spec_k", "draft_depth", "tp_degree", "pipe_schedule")
 
 
 def _paths(target: str) -> list[str]:

@@ -12,30 +12,35 @@
 #                                                # bench.py output) vs
 #                                                # the committed ones
 #   TOLERANCE=0.15 bash tools/ci_bench_check.sh /tmp/fresh
-#   RUN_ELASTIC=1 bash tools/ci_bench_check.sh  # r18: run BENCH_MODE=elastic
-#                                               # fresh (CPU, crash->resume
-#                                               # MTTR + fallback legs) and
-#                                               # gate it vs the committed
-#                                               # elastic record
-#   RUN_SERVE=1 bash tools/ci_bench_check.sh    # r19: run BENCH_MODE=serve
-#                                               # fresh (CPU: continuous-vs-
-#                                               # static tokens/sec, the
-#                                               # zero-recompile pin, live
-#                                               # gauges) and gate it vs the
-#                                               # committed serve record
-#   RUN_SPEC=1 bash tools/ci_bench_check.sh     # r20: run BENCH_MODE=spec
-#                                               # fresh (CPU: speculative
-#                                               # acceptance + FLOPs-adjusted
-#                                               # win, lossless re-check, the
-#                                               # two-program pin) and gate it
-#                                               # vs the committed spec record
-#   RUN_SERVE_TP=1 bash tools/ci_bench_check.sh # r21: run BENCH_MODE=serve_tp
-#                                               # fresh (CPU, 2 virtual
-#                                               # devices: token-for-token
-#                                               # parity vs single replica,
-#                                               # the one-program pin, HLO
-#                                               # ring evidence) and gate it
-#                                               # vs the committed record
+#   RUN_ALL=1 bash tools/ci_bench_check.sh       # r22: every fresh leg
+#                                                # below in one go — the
+#                                                # nightly spelling (sets
+#                                                # all RUN_* flags; budget
+#                                                # ~1.5h on a cold CPU)
+#
+# Per-leg fresh-run flags — each runs one BENCH_MODE on this host and
+# gates its record against the committed one (the gate table):
+#
+#   flag              mode          round  committed record it gates
+#   ----------------  ------------  -----  -------------------------------
+#   RUN_ELASTIC=1     elastic       r18    elastic_cpu_r18.jsonl
+#                                          (crash->resume MTTR + fallback)
+#   RUN_SERVE=1       serve         r19    serve_cpu_r19.jsonl
+#                                          (continuous-vs-static tok/s,
+#                                          zero-recompile pin, gauges)
+#   RUN_SPEC=1        spec          r20    spec_cpu_r20.jsonl
+#                                          (acceptance + FLOPs-adjusted
+#                                          win, lossless re-check)
+#   RUN_SERVE_TP=1    serve_tp      r21    serve_tp_cpu_r21.jsonl
+#                                          (tp decode parity, one-program
+#                                          pin, HLO ring evidence)
+#   RUN_PIPE_COMPOSE=1 pipe_compose r22    pipe_compose_cpu_r22.jsonl
+#                                          (pipe×tp / pipe×ddp parity,
+#                                          branch-collective-free HLO)
+#
+# Modes not listed (train/pipe/quant/...) are exercised by the tier-1
+# suite's contract tests; their committed records still participate in
+# the default self-check and in any directory-vs-directory gate.
 #
 # Exit codes are bench_diff's: 0 in-band, 1 drift, 2 no overlap/usage
 # (an empty comparison must not read as green). Output is the github
@@ -46,11 +51,17 @@ R=bench_records
 CANDIDATE=${1:-$R}
 TOLERANCE=${TOLERANCE:-0.25}
 
+# RUN_ALL=1 is sugar for every per-leg flag (the nightly spelling)
+if [ "${RUN_ALL:-0}" = "1" ]; then
+  RUN_SERVE=1 RUN_SPEC=1 RUN_SERVE_TP=1 RUN_ELASTIC=1 RUN_PIPE_COMPOSE=1
+fi
+
 # fresh-leg flags share ONE scratch dir so RUN_SERVE=1 RUN_ELASTIC=1
 # gates both records (a later block overwriting CANDIDATE would silently
 # discard the earlier run)
 if [ "${RUN_SERVE:-0}" = "1" ] || [ "${RUN_ELASTIC:-0}" = "1" ] \
-    || [ "${RUN_SPEC:-0}" = "1" ] || [ "${RUN_SERVE_TP:-0}" = "1" ]; then
+    || [ "${RUN_SPEC:-0}" = "1" ] || [ "${RUN_SERVE_TP:-0}" = "1" ] \
+    || [ "${RUN_PIPE_COMPOSE:-0}" = "1" ]; then
   FRESH_DIR=$(mktemp -d)
   CANDIDATE=$FRESH_DIR
 fi
@@ -85,6 +96,16 @@ if [ "${RUN_ELASTIC:-0}" = "1" ]; then
     BENCH_MODE=elastic BENCH_STEPS=${BENCH_STEPS:-20} \
     BENCH_WARMUP=${BENCH_WARMUP:-3} \
     timeout 1800 python bench.py | tee "$FRESH_DIR/elastic_fresh.jsonl"
+fi
+
+if [ "${RUN_PIPE_COMPOSE:-0}" = "1" ]; then
+  # the compose legs carve pipe×tp (data:2,model:2,pipe:2) and
+  # pipe×ddp (data:4,pipe:2) from 8 virtual devices: parity vs
+  # sequential stages, FLOPs-matched step ratios, and the r22
+  # branch-collective-free HLO tripwire in one run
+  BENCH_CPU=${BENCH_CPU:-1} BENCH_CPU_DEVICES=${BENCH_CPU_DEVICES:-8} \
+    BENCH_MODE=pipe_compose \
+    timeout 1800 python bench.py | tee "$FRESH_DIR/pipe_compose_fresh.jsonl"
 fi
 
 python tools/bench_diff.py "$R" "$CANDIDATE" \

@@ -19,10 +19,10 @@
 # bench mode appends one JSON line to its round's records file.
 # Usage: bash tools/tpu_followup.sh <round>   (requires the axon tunnel)
 set -u
-ROUND=${1:?usage: tpu_followup.sh <round: 4..21>}
+ROUND=${1:?usage: tpu_followup.sh <round: 4..22>}
 case "$ROUND" in (*[!0-9]*|'') echo "round must be a number, got '$ROUND'" >&2; exit 2;; esac
-if [ "$ROUND" -lt 4 ] || [ "$ROUND" -gt 21 ]; then
-  echo "unknown round $ROUND (expected 4..21)" >&2; exit 2
+if [ "$ROUND" -lt 4 ] || [ "$ROUND" -gt 22 ]; then
+  echo "unknown round $ROUND (expected 4..22)" >&2; exit 2
 fi
 cd "$(dirname "$0")/.."
 R=bench_records
@@ -382,6 +382,28 @@ legs_r21() {
   python tools/bench_diff.py "$R" "$R/serve_tp_tpu_r21.jsonl" --format github \
     > "$R/bench_diff_tpu_r21.md" 2>>"$ERR" \
     || echo "bench_diff flagged drift (see bench_diff_tpu_r21.md)" >&2
+}
+
+legs_r22() {
+  # 4D composition: the BENCH_MODE=pipe_compose legs on real chips. The
+  # CPU record (pipe_compose_cpu_r22.jsonl) proves pipe x tp / pipe x ddp
+  # parity vs sequential stages and the branch-collective-free slot
+  # body; chips are needed for (a) the LOCKSTEP step ratios -- on the
+  # 1-core CPU the boundary waves serialise as extra work, on chips the
+  # tp psums and masked ddp reduces overlap under adjacent microbatch
+  # compute the way the makespan model predicts (each leg carries
+  # step_time_plain/composed from the same mesh), (b) the pipe x tp
+  # geometry at a real model axis (data x model:2 x pipe:2 needs 8
+  # chips; BENCH_MICRO sweeps the bubble down), and (c) ICI-priced
+  # wire_bytes_pipe/model attribution from --perf_report on a composed
+  # run. A 1-chip tunnel can only re-prove the CPU story -- both multi-
+  # chip legs below degrade to degenerate records there.
+  run pipe_compose_m4 pipe_compose_tpu_r22.jsonl 1800 BENCH_MODE=pipe_compose
+  run pipe_compose_m8 pipe_compose_tpu_r22.jsonl 1800 BENCH_MODE=pipe_compose \
+    BENCH_MICRO=8
+  python tools/bench_diff.py "$R" "$R/pipe_compose_tpu_r22.jsonl" --format github \
+    > "$R/bench_diff_tpu_r22.md" 2>>"$ERR" \
+    || echo "bench_diff flagged drift (see bench_diff_tpu_r22.md)" >&2
 }
 
 # -- the historical chain ---------------------------------------------------
